@@ -1,0 +1,25 @@
+"""kubeshare_tpu — a TPU-native fractional-accelerator sharing framework.
+
+Re-creates the capabilities of KubeShare 2.0 (NTHU-LSALAB/KubeShare) for Cloud
+TPU: pods request fractions of a TPU chip via ``sharedgpu/*`` labels, a
+scheduler plugin bin-packs and gang-schedules them onto specific chips using a
+topology-aware cell hierarchy over the ICI mesh, per-node daemons export
+inventory and placement, and a native C++ token runtime enforces each pod's
+compute share and HBM cap at execution time.
+
+Layout (see SURVEY.md for the reference layer map this mirrors):
+
+- ``cell``       topology model + allocator      (ref pkg/scheduler/cell.go, config.go)
+- ``scheduler``  scheduling-framework plugin     (ref pkg/scheduler/*)
+- ``cluster``    cluster-API abstraction + fake  (ref k8s informers/clientset)
+- ``collector``  chip-inventory exporter         (ref pkg/collector, NVML -> libtpu/JAX)
+- ``aggregator`` placement exporter              (ref pkg/aggregator)
+- ``configd``    per-node config daemon          (ref pkg/config)
+- ``isolation``  in-process enforcement client   (ref Gemini hook libgemhook.so.1)
+- ``runtime``    supervisor for native daemons   (ref docker/kubeshare-gemini-scheduler/launcher.py)
+- ``models/ops/parallel``  TPU workload library (JAX/pjit/pallas) — the
+  compute path the framework schedules; absent in the reference (it schedules
+  external PyTorch workloads) but first-class here.
+"""
+
+__version__ = "0.1.0"

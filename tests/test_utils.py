@@ -1,0 +1,129 @@
+import threading
+import urllib.request
+
+from kubeshare_tpu.utils.atomicfile import write_atomic
+from kubeshare_tpu.utils.bitmap import Bitmap, RRBitmap
+from kubeshare_tpu.utils.promtext import (
+    MetricFamily,
+    MetricServer,
+    encode_families,
+    parse_text,
+)
+
+
+class TestBitmap:
+    def test_mask_unmask(self):
+        bm = Bitmap()
+        assert not bm.is_masked(5)
+        bm.mask(5)
+        assert bm.is_masked(5)
+        bm.unmask(5)
+        assert not bm.is_masked(5)
+
+    def test_find_next_and_set(self):
+        bm = Bitmap()
+        assert bm.find_next_and_set() == 0
+        assert bm.find_next_and_set() == 1
+        bm.unmask(0)
+        assert bm.find_next_and_set() == 0
+
+    def test_large_index(self):
+        bm = Bitmap()
+        bm.mask(1000)
+        assert bm.is_masked(1000)
+        assert not bm.is_masked(999)
+
+
+class TestRRBitmap:
+    def test_round_robin(self):
+        # mirrors the port pool usage: Mask(0) then round-robin grants
+        rr = RRBitmap(4)
+        rr.mask(0)
+        assert rr.find_next_from_current() == 1
+        assert rr.find_next_from_current_and_set() == 1
+        assert rr.find_next_from_current_and_set() == 2
+        # freeing an earlier slot: round robin continues forward first
+        rr.unmask(1)
+        assert rr.find_next_from_current_and_set() == 3
+        assert rr.find_next_from_current_and_set() == 1
+
+    def test_exhaustion(self):
+        rr = RRBitmap(2)
+        assert rr.find_next_from_current_and_set() == 0
+        assert rr.find_next_from_current_and_set() == 1
+        assert rr.find_next_from_current() == -1
+        assert rr.find_next_from_current_and_set() == -1
+        rr.unmask(0)
+        assert rr.find_next_from_current_and_set() == 0
+
+    def test_wraparound(self):
+        rr = RRBitmap(3)
+        for _ in range(3):
+            rr.find_next_from_current_and_set()
+        rr.unmask(1)
+        assert rr.find_next_from_current_and_set() == 1
+
+
+class TestPromText:
+    def test_round_trip(self):
+        fam = MetricFamily("gpu_capacity", "GPU information (in Byte).")
+        fam.add(
+            {"node": "host-a", "uuid": "tpu-0", "model": "TPU-v4", "memory": "34359738368"},
+            1700000000,
+        )
+        fam.add({"node": "host-a", "uuid": "tpu-1", "model": "TPU-v4", "memory": "34359738368"}, 2)
+        text = encode_families([fam])
+        assert "# TYPE gpu_capacity counter" in text
+        samples = parse_text(text)
+        assert len(samples) == 2
+        assert samples[0].name == "gpu_capacity"
+        assert samples[0].labels["uuid"] == "tpu-0"
+        assert samples[0].value == 1700000000
+
+    def test_escaping(self):
+        fam = MetricFamily("m", "h")
+        fam.add({"k": 'a"b\\c\nd'}, 1.5)
+        samples = parse_text(encode_families([fam]))
+        assert samples[0].labels["k"] == 'a"b\\c\nd'
+        assert samples[0].value == 1.5
+
+    def test_server_scrape(self):
+        fam = MetricFamily("gpu_requirement", "req")
+        fam.add({"pod": "p1"}, 3)
+        server = MetricServer(lambda: [fam], port=0, path="/kubeshare-collector")
+        server.start()
+        try:
+            url = f"http://127.0.0.1:{server.port}/kubeshare-collector"
+            body = urllib.request.urlopen(url, timeout=5).read().decode()
+            assert 'gpu_requirement{pod="p1"} 3' in body
+        finally:
+            server.stop()
+
+
+class TestAtomicFile:
+    def test_write_and_concurrent_read(self, tmp_path):
+        path = str(tmp_path / "cfg")
+        write_atomic(path, "1\nns/pod 1.0 0.5 1024\n")
+        assert open(path).read().startswith("1\n")
+
+        # hammer writes while reading: reader must never see a torn file
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                write_atomic(path, f"{i}\n" + "x" * (i % 512) + "\n")
+                i += 1
+
+        t = threading.Thread(target=writer)
+        t.start()
+        try:
+            for _ in range(200):
+                data = open(path).read()
+                if not data.endswith("\n"):
+                    errors.append(data)
+        finally:
+            stop.set()
+            t.join()
+        assert not errors
