@@ -1,0 +1,19 @@
+from .spec import CellTypeSpec, CellSpec, TopologyConfig, load_config, infer_cell_spec
+from .element import CellElement, build_cell_chains
+from .cell import Cell, CellState, build_cell_forest
+from .allocator import CellAllocator, ChipInfo
+
+__all__ = [
+    "CellTypeSpec",
+    "CellSpec",
+    "TopologyConfig",
+    "load_config",
+    "infer_cell_spec",
+    "CellElement",
+    "build_cell_chains",
+    "Cell",
+    "CellState",
+    "build_cell_forest",
+    "CellAllocator",
+    "ChipInfo",
+]

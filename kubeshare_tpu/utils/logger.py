@@ -34,7 +34,13 @@ def get_logger(
     """
     logger = logging.getLogger("kubeshare." + name)
     if logger.handlers:
-        return logger
+        # reconfigure when a caller asks for a different sink/level (daemon
+        # main after library import); default calls reuse the cached config
+        if log_dir is None and level == 2:
+            return logger
+        for h in list(logger.handlers):
+            logger.removeHandler(h)
+            h.close()
     logger.setLevel(_LEVELS.get(level, logging.INFO))
     logger.propagate = False
 

@@ -1,0 +1,289 @@
+"""Cell model tests: config inference, type chains, forest build, allocator.
+
+Topologies mirror the reference examples (deploy/config/kubeshare-config*.yaml)
+re-cast to TPU models, plus the original GPU ones for parity checks.
+"""
+
+import pytest
+
+from kubeshare_tpu.cell import (
+    CellAllocator,
+    CellState,
+    ChipInfo,
+    build_cell_chains,
+    build_cell_forest,
+    load_config,
+)
+from kubeshare_tpu.cell.spec import ConfigError
+from kubeshare_tpu.cell.topology import (
+    cell_id_distance,
+    generate_tpu_topology_config,
+    ici_distance,
+)
+
+# reference deploy/config/kubeshare-config2.yaml, TPU-ified
+HETERO_CONFIG = """
+cellTypes:
+  V4-NODE:
+    childCellType: "TPU-v4"
+    childCellNumber: 4
+    childCellPriority: 60
+    isNodeLevel: true
+  3-V4-NODE:
+    childCellType: V4-NODE
+    childCellNumber: 3
+  V5E-NODE:
+    childCellType: "TPU-v5e"
+    childCellNumber: 8
+    childCellPriority: 80
+    isNodeLevel: true
+cells:
+- cellType: 3-V4-NODE
+  cellChildren:
+  - cellId: juno
+  - cellId: apple
+  - cellId: lemon
+- cellType: V5E-NODE
+  cellId: cupid
+"""
+
+
+def hetero_setup():
+    config = load_config(text=HETERO_CONFIG)
+    elements, priority, sorted_models = build_cell_chains(config.cell_types)
+    forest = build_cell_forest(elements, config.cells)
+    return config, elements, priority, sorted_models, forest
+
+
+def make_chips(prefix, n, memory=32 << 30, model="TPU-v4"):
+    return [ChipInfo(uuid=f"{prefix}-{i}", memory=memory, model=model, index=i) for i in range(n)]
+
+
+class TestSpecInference:
+    def test_ids_inferred_level_order(self):
+        config = load_config(text=HETERO_CONFIG)
+        root = config.cells[0]
+        assert root.cell_id == "1"
+        assert [c.cell_id for c in root.children] == ["1/juno", "1/apple", "1/lemon"]
+        # leaf numbering is by position within the BFS level (ref quirk)
+        leaves = [leaf.cell_id for child in root.children for leaf in child.children]
+        assert leaves[:4] == ["1/juno/1", "1/juno/2", "1/juno/3", "1/juno/4"]
+        assert leaves[4] == "1/apple/5"
+        assert leaves[-1] == "1/lemon/12"
+        assert config.cells[1].cell_id == "cupid"
+        assert [c.cell_id for c in config.cells[1].children] == [
+            f"cupid/{i}" for i in range(1, 9)
+        ]
+
+    def test_unknown_cell_type_rejected(self):
+        with pytest.raises(ConfigError):
+            load_config(text="cellTypes: {}\ncells:\n- cellType: NOPE\n")
+
+    def test_priority_range_enforced(self):
+        bad = """
+cellTypes:
+  X-NODE:
+    childCellType: "TPU-v4"
+    childCellNumber: 1
+    childCellPriority: 101
+    isNodeLevel: true
+cells:
+- cellType: X-NODE
+  cellId: n1
+"""
+        with pytest.raises(ConfigError):
+            load_config(text=bad)
+
+
+class TestCellChains:
+    def test_elements(self):
+        _, elements, priority, sorted_models, _ = hetero_setup()
+        v4 = elements["TPU-v4"]
+        assert v4.level == 1 and v4.leaf_cell_number == 1.0
+        node = elements["V4-NODE"]
+        assert node.level == 2 and node.is_node and not node.is_multi_nodes
+        assert node.leaf_cell_number == 4.0
+        top = elements["3-V4-NODE"]
+        assert top.level == 3 and top.is_multi_nodes and not top.is_node
+        assert top.leaf_cell_number == 12.0
+        assert priority == {"TPU-v4": 60, "TPU-v5e": 80}
+        assert sorted_models == ["TPU-v5e", "TPU-v4"]
+
+
+class TestForest:
+    def test_build(self):
+        _, _, _, _, forest = hetero_setup()
+        assert set(forest.keys()) == {"TPU-v4", "TPU-v5e"}
+        v4_root = forest["TPU-v4"][3][0]
+        assert v4_root.node == ""  # multi-node cell has no single node
+        assert [c.node for c in v4_root.children] == ["juno", "apple", "lemon"]
+        juno = v4_root.children[0]
+        assert all(leaf.node == "juno" for leaf in juno.leaves())
+        # capacity accrues only as chips bind, never from declaration
+        assert v4_root.available == 0.0 and v4_root.leaf_cell_number == 12.0
+        v5e_root = forest["TPU-v5e"][2][0]
+        assert v5e_root.node == "cupid" and v5e_root.leaf_cell_number == 8.0
+
+    def test_top_cell_must_be_node_level(self):
+        cfg = load_config(
+            text="cellTypes: {}\ncells: []\n"
+        )
+        assert cfg.cells == []
+        chiponly = """
+cellTypes:
+  V4-NODE:
+    childCellType: "TPU-v4"
+    childCellNumber: 1
+    isNodeLevel: true
+cells:
+- cellType: V4-NODE
+  cellId: n1
+"""
+        config = load_config(text=chiponly)
+        elements, _, _ = build_cell_chains(config.cell_types)
+        with pytest.raises(ValueError):
+            build_cell_forest(elements, [type(config.cells[0])(cell_type="TPU-v4", cell_id="x")])
+
+
+class TestAllocator:
+    def setup_method(self):
+        _, _, priority, _, forest = hetero_setup()
+        self.alloc = CellAllocator(forest, priority)
+        self.alloc.set_node_inventory("juno", make_chips("juno", 4))
+        self.alloc.set_node_status("juno", True)
+
+    def test_inventory_binding(self):
+        juno_leaves = self.alloc.leaf_cells_by_node("juno")
+        assert len(juno_leaves) == 4
+        assert [l.uuid for l in juno_leaves] == [f"juno-{i}" for i in range(4)]
+        assert all(l.full_memory == 32 << 30 for l in juno_leaves)
+        assert all(l.state == CellState.FILLED for l in juno_leaves)
+        # memory bubbled to node cell and root
+        node_cell = juno_leaves[0].parent
+        assert node_cell.full_memory == 4 * (32 << 30)
+        root = node_cell.parent
+        assert root.full_memory == 4 * (32 << 30)
+        # unbound node has no leaves reported
+        assert self.alloc.leaf_cells_by_node("apple") == []
+
+    def test_reserve_reclaim(self):
+        leaf = self.alloc.leaf_cells["juno-0"]
+        self.alloc.reserve(leaf, 0.5, 16 << 30)
+        assert leaf.available == 0.5
+        assert leaf.available_whole_cell == 0
+        assert leaf.free_memory == 16 << 30
+        node = leaf.parent
+        assert node.available == 3.5 and node.available_whole_cell == 3
+        self.alloc.reclaim(leaf, 0.5, 16 << 30)
+        assert leaf.available == 1.0 and node.available == 4.0
+        assert node.available_whole_cell == 4
+
+    def test_fractional_fit(self):
+        fit, _, _ = self.alloc.filter_node("juno", "TPU-v4", 0.5, 1 << 30)
+        assert fit
+        # too much memory
+        fit, _, _ = self.alloc.filter_node("juno", "TPU-v4", 0.5, 64 << 30)
+        assert not fit
+        # after reserving 0.6 on every leaf, a 0.5 request no longer fits
+        for leaf in self.alloc.leaf_cells_by_node("juno"):
+            self.alloc.reserve(leaf, 0.6, 1 << 30)
+        fit, _, _ = self.alloc.filter_node("juno", "TPU-v4", 0.5, 1 << 30)
+        assert not fit
+        fit, _, _ = self.alloc.filter_node("juno", "TPU-v4", 0.4, 1 << 30)
+        assert fit
+
+    def test_multichip_fit(self):
+        fit, avail, _ = self.alloc.filter_node("juno", "TPU-v4", 2.0, 0)
+        assert fit and avail >= 2
+        fit, _, _ = self.alloc.filter_node("juno", "TPU-v4", 5.0, 0)
+        assert not fit  # only 4 chips on juno
+        # fractional use on one chip removes it from whole-cell counting
+        leaf = self.alloc.leaf_cells["juno-0"]
+        self.alloc.reserve(leaf, 0.1, 1 << 30)
+        fit, _, _ = self.alloc.filter_node("juno", "TPU-v4", 4.0, 0)
+        assert not fit
+        fit, _, _ = self.alloc.filter_node("juno", "TPU-v4", 3.0, 0)
+        assert fit
+
+    def test_unknown_model(self):
+        fit, _, _ = self.alloc.filter_node("juno", "TPU-v9", 0.5, 0)
+        assert not fit
+
+    def test_health_toggle(self):
+        assert self.alloc.filter_node("juno", "TPU-v4", 0.5, 0)[0]
+        self.alloc.set_node_status("juno", False)
+        assert not self.alloc.filter_node("juno", "TPU-v4", 0.5, 0)[0]
+        assert self.alloc.leaf_cells_by_node("juno") == []
+        self.alloc.set_node_status("juno", True)
+        assert self.alloc.filter_node("juno", "TPU-v4", 0.5, 0)[0]
+        # reservations survive a health bounce
+        leaf = self.alloc.leaf_cells["juno-0"]
+        self.alloc.reserve(leaf, 0.5, 1)
+        self.alloc.set_node_status("juno", False)
+        self.alloc.set_node_status("juno", True)
+        assert leaf.available == 0.5
+
+    def test_second_node_binding(self):
+        self.alloc.set_node_inventory("apple", make_chips("apple", 4))
+        self.alloc.set_node_status("apple", True)
+        assert len(self.alloc.leaf_cells_by_node("apple")) == 4
+        # juno's bindings untouched
+        assert self.alloc.leaf_cells["juno-0"].uuid == "juno-0"
+        # root capacity reflects both bound nodes
+        root = self.alloc.leaf_cells["juno-0"].parent.parent
+        assert root.available == 8.0
+
+    def test_inventory_after_health_event(self):
+        # health event raced ahead of the collector's first scrape
+        self.alloc.set_node_status("apple", True)
+        assert not self.alloc.filter_node("apple", "TPU-v4", 0.5, 0)[0]
+        self.alloc.set_node_inventory("apple", make_chips("apple", 4))
+        assert self.alloc.filter_node("apple", "TPU-v4", 0.5, 1 << 30)[0]
+        assert len(self.alloc.leaf_cells_by_node("apple")) == 4
+
+    def test_no_phantom_multichip_capacity(self):
+        # healthy node with zero bound chips must not satisfy gang requests
+        self.alloc.set_node_status("lemon", True)
+        assert not self.alloc.filter_node("lemon", "TPU-v4", 2.0, 0)[0]
+        # partial inventory: only what is bound counts
+        self.alloc.set_node_inventory("lemon", make_chips("lemon", 2))
+        fit, avail, _ = self.alloc.filter_node("lemon", "TPU-v4", 2.0, 0)
+        assert fit and avail == 2.0
+        assert not self.alloc.filter_node("lemon", "TPU-v4", 3.0, 0)[0]
+
+
+class TestDistance:
+    def test_cell_id_distance_reference_cases(self):
+        # aligned numeric tails
+        assert cell_id_distance(["ubuntu", "1", "3"], "ubuntu/1/2") == 1
+        assert cell_id_distance(["ubuntu", "1", "3"], "ubuntu/1/3") == 0
+        # node-name mismatch costs 100
+        assert cell_id_distance(["juno", "1"], "apple/1") == 100
+        # shorter id: leftover numeric segments add their value
+        assert cell_id_distance(["2", "1"], "1") == 2
+        # leftover non-numeric adds 100
+        assert cell_id_distance(["a", "2", "1"], "2/1") == 100
+
+    def test_ici_distance(self):
+        assert ici_distance((0, 0, 0), (1, 2, 3)) == 6
+        assert ici_distance((0, 0), (3, 0), torus_dims=(4, 4)) == 1  # wrap
+        assert ici_distance((0,), (2, 1)) == 3  # rank padding
+
+
+class TestTpuTopologyGen:
+    def test_generate_and_build(self):
+        config = generate_tpu_topology_config(
+            [("host-a", "TPU-v4", 4), ("host-b", "TPU-v4", 4), ("host-c", "TPU-v5e", 8)]
+        )
+        elements, priority, _ = build_cell_chains(config.cell_types)
+        forest = build_cell_forest(elements, config.cells)
+        assert priority["TPU-v5e"] == 80 and priority["TPU-v4"] == 60
+        # two v4 hosts grouped under one multi-node cell
+        v4_root = forest["TPU-v4"][3][0]
+        assert sorted(c.node for c in v4_root.children) == ["host-a", "host-b"]
+        v5e_root = forest["TPU-v5e"][2][0]
+        assert v5e_root.node == "host-c"
+        alloc = CellAllocator(forest, priority)
+        alloc.set_node_inventory("host-a", make_chips("host-a", 4))
+        alloc.set_node_status("host-a", True)
+        assert alloc.filter_node("host-a", "TPU-v4", 2.0, 0)[0]
