@@ -19,6 +19,17 @@ from typing import Dict, Iterable, List, Optional, Tuple
 from .cell import Cell, CellState, FreeCellList
 
 
+def _quantize(value: float) -> float:
+    """Keep fractional-chip arithmetic exact: user requests carry at most a
+    few decimals, so rounding to micro-chips kills float drift that would
+    otherwise strand whole-chip capacity (0.3+0.1 released -> 0.99999...)."""
+    return round(value, 6)
+
+
+def _floor(value: float) -> float:
+    return math.floor(value + 1e-9)
+
+
 @dataclass
 class ChipInfo:
     """One accelerator chip as reported by the collector
@@ -85,10 +96,11 @@ class CellAllocator:
         chips = self.chip_infos.get(node, {}).get(root.leaf_cell_type, [])
         if not chips:
             return
-        leaves = [l for l in root.leaves() if l.node == node]
+        # pair only unbound leaves with not-yet-bound chips so a partial
+        # first scrape followed by a fuller one binds correctly
+        leaves = [l for l in root.leaves() if l.node == node and not l.uuid]
+        chips = [c for c in chips if c.uuid not in self.leaf_cells]
         for leaf, chip in zip(leaves, chips):
-            if leaf.uuid:
-                continue  # already bound (idempotent re-registration)
             leaf.uuid = chip.uuid
             leaf.full_memory = chip.memory
             leaf.free_memory += chip.memory
@@ -98,8 +110,8 @@ class CellAllocator:
             # physical chips bind (declared-but-absent chips never count)
             for cell in [leaf, *leaf.ancestors()]:
                 cell.state = CellState.FILLED
-                cell.available += 1.0
-                cell.available_whole_cell = math.floor(cell.available)
+                cell.available = _quantize(cell.available + 1.0)
+                cell.available_whole_cell = _floor(cell.available)
                 if cell is not leaf:
                     cell.free_memory += chip.memory
                     cell.full_memory += chip.memory
@@ -132,15 +144,15 @@ class CellAllocator:
         with self.lock:
             for current in [cell, *cell.ancestors()]:
                 current.free_memory -= memory
-                current.available -= request
-                current.available_whole_cell = math.floor(current.available)
+                current.available = _quantize(current.available - request)
+                current.available_whole_cell = _floor(current.available)
 
     def reclaim(self, cell: Cell, request: float, memory: int) -> None:
         with self.lock:
             for current in [cell, *cell.ancestors()]:
                 current.free_memory += memory
-                current.available += request
-                current.available_whole_cell = math.floor(current.available)
+                current.available = _quantize(current.available + request)
+                current.available_whole_cell = _floor(current.available)
 
     # ------------------------------------------------------------------
     # fit checks (ref filter.go)

@@ -143,7 +143,3 @@ def _build_cell(
             child.node = current_node
         cell.children.append(child)
     return cell
-
-
-def floor_whole(available: float) -> float:
-    return math.floor(available)

@@ -10,7 +10,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, List, Tuple
 
-from .spec import CellTypeSpec
+from .spec import CellTypeSpec, ConfigError
 
 LOWEST_LEVEL = 1
 
@@ -35,10 +35,14 @@ def build_cell_chains(
     priority desc) — ref cell.go:46-72."""
     elements: Dict[str, CellElement] = {}
     chip_priority: Dict[str, int] = {}
+    in_progress: set = set()
 
     def add(cell_type: str, priority: int) -> None:
         if cell_type in elements:
             return
+        if cell_type in in_progress:
+            raise ConfigError(f"cellTypes contains a cycle through {cell_type!r}")
+        in_progress.add(cell_type)
         cts = cell_types.get(cell_type)
         if cts is None:
             # not declared as a composite type => it's a leaf (a chip model)

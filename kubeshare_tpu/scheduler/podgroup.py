@@ -1,0 +1,114 @@
+"""Gang-scheduling pod groups (ref pkg/scheduler/pod_group.go).
+
+A pod opts into a gang with ``group_name`` + ``group_headcount`` +
+``group_threshold``; minAvailable = round(headcount * threshold).  Group
+state is tracked for queue ordering (priority + init timestamp) and the
+Permit barrier, and garbage-collected after expiry.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from .. import constants
+from ..cluster.api import Clock, Pod
+
+
+def parse_pod_group_labels(pod: Pod) -> Tuple[str, int, float, int]:
+    """Returns (group_name, headcount, threshold, min_available); all-empty
+    for non-gang pods or malformed gang labels (ref pod_group.go:86-117 —
+    malformed values demote to a regular pod, they do not error)."""
+    group_name = pod.labels.get(constants.POD_GROUP_NAME, "")
+    if not group_name:
+        return "", 0, 0.0, 0
+    raw_headcount = pod.labels.get(constants.POD_GROUP_HEADCOUNT, "")
+    if not raw_headcount:
+        return "", 0, 0.0, 0
+    try:
+        headcount = int(raw_headcount)
+    except ValueError:
+        return "", 0, 0.0, 0
+    if headcount < 1:
+        return "", 0, 0.0, 0
+    raw_threshold = pod.labels.get(constants.POD_GROUP_THRESHOLD, "")
+    if not raw_threshold:
+        return "", 0, 0.0, 0
+    try:
+        threshold = float(raw_threshold)
+    except ValueError:
+        return "", 0, 0.0, 0
+    if threshold <= 0:
+        return "", 0, 0.0, 0
+    min_available = int(math.floor(threshold * headcount + 0.5))
+    return group_name, headcount, threshold, min_available
+
+
+@dataclass
+class PodGroupInfo:
+    key: str  # "<namespace>/<group name>"; "" for regular pods
+    name: str
+    priority: int
+    timestamp: float  # initial scheduling-attempt timestamp
+    min_available: int
+    head_count: int
+    threshold: float
+    deletion_timestamp: Optional[float] = None
+
+
+class PodGroupRegistry:
+    def __init__(self, clock: Optional[Clock] = None, expiration_seconds: float = constants.POD_GROUP_EXPIRATION_TIME_SECONDS):
+        self._groups: Dict[str, PodGroupInfo] = {}
+        self._lock = threading.RLock()
+        self._clock = clock or Clock()
+        self._expiration = expiration_seconds
+
+    def get_or_create(self, pod: Pod, timestamp: float, priority: int) -> PodGroupInfo:
+        """ref pod_group.go:40-81; regular pods get an ephemeral record with
+        empty key that is never stored."""
+        group_name, headcount, threshold, min_available = parse_pod_group_labels(pod)
+        key = f"{pod.namespace}/{group_name}" if group_name and min_available > 0 else ""
+        with self._lock:
+            if key and key in self._groups:
+                info = self._groups[key]
+                if info.deletion_timestamp is not None:
+                    info.deletion_timestamp = None  # re-activate
+                return info
+            info = PodGroupInfo(
+                key=key,
+                name=group_name,
+                priority=priority,
+                timestamp=timestamp,
+                min_available=min_available,
+                head_count=headcount,
+                threshold=threshold,
+            )
+            if key:
+                self._groups[key] = info
+            return info
+
+    def mark_deleted(self, key: str) -> None:
+        with self._lock:
+            info = self._groups.get(key)
+            if info is not None:
+                info.deletion_timestamp = self._clock.now()
+
+    def remove(self, key: str) -> None:
+        with self._lock:
+            self._groups.pop(key, None)
+
+    def gc(self) -> None:
+        """Drop groups expired longer than the expiration window
+        (ref pod_group.go:119-129)."""
+        now = self._clock.now()
+        with self._lock:
+            for key in list(self._groups):
+                ts = self._groups[key].deletion_timestamp
+                if ts is not None and ts + self._expiration < now:
+                    del self._groups[key]
+
+    def get(self, key: str) -> Optional[PodGroupInfo]:
+        with self._lock:
+            return self._groups.get(key)

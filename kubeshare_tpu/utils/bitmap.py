@@ -1,9 +1,10 @@
 """Round-robin bitmap used as the per-node pod-manager port pool.
 
 Behavioral parity with the reference allocator (ref pkg/lib/bitmap/
-bitmap.go:11-51, rrbitmap.go:17-43): index 0 is masked at pool creation so
-the first granted port is base+1, allocation is round-robin starting after
-the most recently granted index, and exhaustion returns -1.
+bitmap.go:11-51, rrbitmap.go:17-43): allocation is round-robin starting
+after the most recently granted index and exhaustion returns -1.  As in the
+reference, the pool *creator* masks index 0 (so the first granted port is
+base+1; ref node.go:38-39) — the bitmap itself reserves nothing.
 
 Implemented with a Python int as the bit store (arbitrary precision) rather
 than a uint64 slice — same observable behavior, no manual word management.

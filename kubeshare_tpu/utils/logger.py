@@ -34,13 +34,7 @@ def get_logger(
     """
     logger = logging.getLogger("kubeshare." + name)
     if logger.handlers:
-        # reconfigure when a caller asks for a different sink/level (daemon
-        # main after library import); default calls reuse the cached config
-        if log_dir is None and level == 2:
-            return logger
-        for h in list(logger.handlers):
-            logger.removeHandler(h)
-            h.close()
+        return logger
     logger.setLevel(_LEVELS.get(level, logging.INFO))
     logger.propagate = False
 
@@ -58,3 +52,18 @@ def get_logger(
     handler.setFormatter(logging.Formatter(_FORMAT, _DATEFMT))
     logger.addHandler(handler)
     return logger
+
+
+def configure_logger(
+    name: str,
+    level: int = 2,
+    log_dir: Optional[str] = None,
+    filename: Optional[str] = None,
+) -> logging.Logger:
+    """Explicitly (re)configure a component logger — daemon mains call this
+    once at startup; library code uses get_logger, which never reconfigures."""
+    logger = logging.getLogger("kubeshare." + name)
+    for h in list(logger.handlers):
+        logger.removeHandler(h)
+        h.close()
+    return get_logger(name, level, log_dir, filename)

@@ -1,0 +1,445 @@
+"""Scheduler tests: label parsing, pipeline behavior, gang scheduling,
+priority classes, recovery — the acceptance matrix from BASELINE.md configs
+and the reference's test/ YAML scenarios (SURVEY §2.12)."""
+
+import pytest
+
+from kubeshare_tpu import constants
+from kubeshare_tpu.cell import load_config
+from kubeshare_tpu.cluster.api import FakeClock, Node, Pod, PodPhase
+from kubeshare_tpu.cluster.fake import FakeCluster
+from kubeshare_tpu.cell.allocator import ChipInfo
+from kubeshare_tpu.scheduler import (
+    KubeShareScheduler,
+    PodLabelError,
+    SchedulerArgs,
+    SchedulerEngine,
+    parse_pod_labels,
+)
+
+TOPOLOGY = """
+cellTypes:
+  V4-NODE:
+    childCellType: "TPU-v4"
+    childCellNumber: 4
+    childCellPriority: 60
+    isNodeLevel: true
+  2-V4-NODE:
+    childCellType: V4-NODE
+    childCellNumber: 2
+  V5E-NODE:
+    childCellType: "TPU-v5e"
+    childCellNumber: 8
+    childCellPriority: 80
+    isNodeLevel: true
+cells:
+- cellType: 2-V4-NODE
+  cellChildren:
+  - cellId: host-a
+  - cellId: host-b
+- cellType: V5E-NODE
+  cellId: host-c
+"""
+
+HBM = 32 << 30
+
+INVENTORY = {
+    "host-a": [ChipInfo(f"host-a-tpu-{i}", HBM, "TPU-v4", i, (i, 0, 0)) for i in range(4)],
+    "host-b": [ChipInfo(f"host-b-tpu-{i}", HBM, "TPU-v4", i, (i, 1, 0)) for i in range(4)],
+    "host-c": [ChipInfo(f"host-c-tpu-{i}", 16 << 30, "TPU-v5e", i) for i in range(8)],
+}
+
+
+def shared_pod(name, request="0.5", limit="1.0", mem=None, priority=None, model=None,
+               group=None, headcount=None, threshold=None, namespace="default"):
+    labels = {constants.POD_GPU_LIMIT: limit}
+    if request is not None:
+        labels[constants.POD_GPU_REQUEST] = request
+    if mem is not None:
+        labels[constants.POD_GPU_MEMORY] = str(mem)
+    if priority is not None:
+        labels[constants.POD_PRIORITY] = str(priority)
+    if model is not None:
+        labels[constants.POD_GPU_MODEL] = model
+    if group is not None:
+        labels[constants.POD_GROUP_NAME] = group
+        labels[constants.POD_GROUP_HEADCOUNT] = str(headcount)
+        labels[constants.POD_GROUP_THRESHOLD] = str(threshold)
+    return Pod(namespace=namespace, name=name, labels=labels,
+               scheduler_name=constants.SCHEDULER_NAME)
+
+
+def make_env(nodes=("host-a", "host-b", "host-c"), bind_mode="patch"):
+    cluster = FakeCluster()
+    for n in nodes:
+        cluster.add_node(Node(name=n, labels={constants.NODE_LABEL_FILTER: "true"}))
+    clock = FakeClock(1000.0)
+    plugin = KubeShareScheduler(
+        topology=load_config(text=TOPOLOGY),
+        cluster=cluster,
+        inventory=lambda node: INVENTORY.get(node, []),
+        args=SchedulerArgs(bind_mode=bind_mode),
+        clock=clock,
+    )
+    engine = SchedulerEngine(plugin, cluster, clock)
+    return cluster, plugin, engine, clock
+
+
+class TestLabelParsing:
+    def test_regular_pod(self):
+        assert parse_pod_labels(Pod(name="p")) is None
+
+    def test_fractional(self):
+        ps = parse_pod_labels(shared_pod("p", request="0.5", limit="1.0", mem=1024))
+        assert ps.request == 0.5 and ps.limit == 1.0 and ps.memory == 1024
+        assert ps.is_opportunistic and not ps.is_multi_chip
+
+    def test_request_defaults_zero(self):
+        ps = parse_pod_labels(shared_pod("p", request=None, limit="0.5"))
+        assert ps.request == 0.0 and ps.limit == 0.5
+
+    def test_limit_required(self):
+        pod = Pod(name="p", labels={constants.POD_GPU_REQUEST: "0.5"})
+        with pytest.raises(PodLabelError):
+            parse_pod_labels(pod)
+
+    def test_request_over_limit_rejected(self):
+        with pytest.raises(PodLabelError):
+            parse_pod_labels(shared_pod("p", request="1.0", limit="0.5"))
+
+    def test_multichip_requires_equal(self):
+        ps = parse_pod_labels(shared_pod("p", request="2.0", limit="2.0"))
+        assert ps.is_multi_chip and ps.request == 2.0
+        with pytest.raises(PodLabelError):
+            parse_pod_labels(shared_pod("p", request="2.0", limit="3.0"))
+
+    def test_non_integer_multichip_rejected(self):
+        with pytest.raises(PodLabelError):
+            parse_pod_labels(shared_pod("p", request="1.5", limit="1.5"))
+
+    def test_zero_zero_is_regular(self):
+        # "0" doesn't match the value format (ref regex), so limit must be
+        # a positive-looking value; 0.0-equivalents via request absent
+        ps = parse_pod_labels(shared_pod("p", request=None, limit="1.0"))
+        assert ps is not None
+
+    def test_priority_bounds(self):
+        assert parse_pod_labels(shared_pod("p", priority="100")).priority == 100
+        assert parse_pod_labels(shared_pod("p", priority="-1")).priority == -1
+        with pytest.raises(PodLabelError):
+            parse_pod_labels(shared_pod("p", priority="101"))
+        with pytest.raises(PodLabelError):
+            parse_pod_labels(shared_pod("p", priority="abc"))
+
+    def test_bad_memory(self):
+        with pytest.raises(PodLabelError):
+            parse_pod_labels(shared_pod("p", mem="12x4"))
+
+    def test_gang_labels(self):
+        ps = parse_pod_labels(
+            shared_pod("p", group="team", headcount=5, threshold=0.4)
+        )
+        assert ps.pod_group == "team" and ps.min_available == 2
+
+
+class TestSchedulingPipeline:
+    def test_fractional_pod_end_to_end(self):
+        cluster, plugin, engine, _ = make_env()
+        pod = shared_pod("mnist1", request="0.5", limit="1.0", priority="100")
+        cluster.create_pod(pod)
+        [result] = engine.run_until_idle()
+        assert result.result == "bound"
+        bound = cluster.get_pod("default", "mnist1")
+        assert bound.node_name in ("host-a", "host-b", "host-c")
+        # injected runtime contract
+        assert bound.annotations[constants.POD_GPU_UUID]
+        assert bound.annotations[constants.POD_CELL_ID]
+        port = int(bound.annotations[constants.POD_MANAGER_PORT])
+        assert port >= constants.POD_MANAGER_PORT_START
+        env = bound.containers[0].env
+        assert env[constants.ENV_VISIBLE_CHIPS] != ""
+        assert env[constants.ENV_SHIM_PRELOAD] == constants.SHIM_LIBRARY
+        assert env[constants.ENV_POD_NAME] == "default/mnist1"
+        # memory defaulted to request * HBM
+        mem = int(bound.annotations[constants.POD_GPU_MEMORY])
+        leaf = plugin.allocator.leaf_cells[bound.annotations[constants.POD_GPU_UUID]]
+        assert mem == int(0.5 * leaf.full_memory)
+        assert leaf.available == 0.5
+
+    def test_guarantee_prefers_higher_priority_model(self):
+        cluster, plugin, engine, _ = make_env()
+        # v5e has chip priority 80 > v4's 60; an idle guarantee pod should
+        # land on the v5e node
+        cluster.create_pod(shared_pod("g", request="0.5", limit="1.0", priority="50"))
+        [result] = engine.run_until_idle()
+        assert result.node == "host-c"
+
+    def test_opportunistic_packs(self):
+        cluster, plugin, engine, _ = make_env(nodes=("host-a", "host-b"))
+        # seed: busy chip on host-a
+        cluster.create_pod(shared_pod("seed", request="0.4", limit="1.0"))
+        engine.run_until_idle()
+        seed = cluster.get_pod("default", "seed")
+        seed_node = seed.node_name
+        # opportunistic pod should pack onto the same node (defrag)
+        cluster.create_pod(shared_pod("opp", request="0.3", limit="1.0"))
+        engine.run_until_idle()
+        opp = cluster.get_pod("default", "opp")
+        assert opp.node_name == seed_node
+        # and onto the same chip
+        assert opp.annotations[constants.POD_GPU_UUID] == seed.annotations[constants.POD_GPU_UUID]
+
+    def test_guarantee_spreads(self):
+        cluster, plugin, engine, _ = make_env(nodes=("host-a",))
+        cluster.create_pod(shared_pod("g1", request="0.6", limit="1.0", priority="10"))
+        engine.run_until_idle()
+        cluster.create_pod(shared_pod("g2", request="0.6", limit="1.0", priority="10"))
+        engine.run_until_idle()
+        g1 = cluster.get_pod("default", "g1")
+        g2 = cluster.get_pod("default", "g2")
+        # 0.6+0.6 can't share one chip; and guarantee prefers idle chips
+        assert g1.annotations[constants.POD_GPU_UUID] != g2.annotations[constants.POD_GPU_UUID]
+
+    def test_model_selector(self):
+        cluster, plugin, engine, _ = make_env()
+        cluster.create_pod(shared_pod("m", request="0.5", limit="1.0", model="TPU-v4"))
+        [result] = engine.run_until_idle()
+        assert result.node in ("host-a", "host-b")
+        cluster.create_pod(shared_pod("m9", request="0.5", limit="1.0", model="TPU-v9"))
+        r2 = engine.run_until_idle()[-1]
+        assert r2.result == "unschedulable"
+
+    def test_multichip_pod(self):
+        cluster, plugin, engine, _ = make_env()
+        cluster.create_pod(shared_pod("big", request="3.0", limit="3.0"))
+        [result] = engine.run_until_idle()
+        assert result.result == "bound"
+        pod = cluster.get_pod("default", "big")
+        uuids = pod.annotations[constants.POD_GPU_UUID].split(",")
+        assert len(uuids) == 3
+        # whole-chip pods get no shim preload and no manager port
+        assert constants.ENV_SHIM_PRELOAD not in pod.containers[0].env
+        assert constants.POD_MANAGER_PORT not in pod.annotations
+        # visible chips are the chip indices
+        chips = pod.containers[0].env[constants.ENV_VISIBLE_CHIPS].split(",")
+        assert len(chips) == 3
+
+    def test_hbm_cap_respected(self):
+        cluster, plugin, engine, _ = make_env(nodes=("host-a",))
+        cluster.create_pod(shared_pod("fat", request="0.5", limit="1.0", mem=30 << 30))
+        engine.run_until_idle()
+        fat = cluster.get_pod("default", "fat")
+        uuid = fat.annotations[constants.POD_GPU_UUID]
+        # second pod needing 4 GiB on same chip won't fit (30+4 > 32)
+        cluster.create_pod(shared_pod("fat2", request="0.4", limit="1.0", mem=4 << 30))
+        engine.run_until_idle()
+        fat2 = cluster.get_pod("default", "fat2")
+        assert fat2.annotations[constants.POD_GPU_UUID] != uuid
+
+    def test_cluster_full(self):
+        cluster, plugin, engine, _ = make_env(nodes=("host-a",))
+        for i in range(4):
+            cluster.create_pod(shared_pod(f"p{i}", request="1.0", limit="1.0"))
+        results = engine.run_until_idle()
+        assert sum(1 for r in results if r.result == "bound") == 4
+        cluster.create_pod(shared_pod("p5", request="1.0", limit="1.0"))
+        results = engine.run_until_idle()
+        assert all(r.result == "unschedulable" for r in results)
+
+    def test_regular_pod_avoids_chip_nodes(self):
+        cluster, plugin, engine, _ = make_env(nodes=("host-a",))
+        cluster.add_node(Node(name="cpu-node"))
+        cluster.create_pod(Pod(name="web", scheduler_name=constants.SCHEDULER_NAME))
+        [result] = engine.run_until_idle()
+        assert result.result == "bound" and result.node == "cpu-node"
+
+    def test_delete_reclaims(self):
+        cluster, plugin, engine, _ = make_env(nodes=("host-a",))
+        cluster.create_pod(shared_pod("p", request="0.5", limit="1.0"))
+        engine.run_until_idle()
+        pod = cluster.get_pod("default", "p")
+        leaf = plugin.allocator.leaf_cells[pod.annotations[constants.POD_GPU_UUID]]
+        port = int(pod.annotations[constants.POD_MANAGER_PORT])
+        assert leaf.available == 0.5
+        cluster.delete_pod("default", "p")
+        assert leaf.available == 1.0
+        assert not plugin.port_bitmaps["host-a"].is_masked(
+            port - constants.POD_MANAGER_PORT_START
+        )
+
+    def test_completed_pod_reclaims(self):
+        cluster, plugin, engine, _ = make_env(nodes=("host-a",))
+        cluster.create_pod(shared_pod("job", request="0.5", limit="1.0"))
+        engine.run_until_idle()
+        pod = cluster.get_pod("default", "job")
+        leaf = plugin.allocator.leaf_cells[pod.annotations[constants.POD_GPU_UUID]]
+        cluster.set_pod_phase("default", "job", PodPhase.SUCCEEDED)
+        assert leaf.available == 1.0
+
+    def test_shadow_bind_mode(self):
+        cluster, plugin, engine, _ = make_env(bind_mode="shadow")
+        cluster.create_pod(shared_pod("s", request="0.5", limit="1.0"))
+        [result] = engine.run_until_idle()
+        assert result.result == "bound"
+        pod = cluster.get_pod("default", "s")
+        assert pod.is_bound() and pod.annotations[constants.POD_GPU_UUID]
+
+
+class TestGangScheduling:
+    def test_gang_waits_then_binds(self):
+        cluster, plugin, engine, clock = make_env()
+        for i in range(3):
+            cluster.create_pod(
+                shared_pod(f"w{i}", request="0.5", limit="1.0",
+                           group="team", headcount=3, threshold=1.0)
+            )
+        results = engine.run_until_idle()
+        bound = [r for r in results if r.result == "bound"]
+        waiting = [r for r in results if r.result == "waiting"]
+        assert len(waiting) == 2 and len(bound) >= 1
+        # all three end up placed
+        placed = [p for p in cluster.list_pods() if p.is_bound()]
+        assert len(placed) == 3
+        assert engine.waiting_count() == 0
+
+    def test_gang_below_min_unschedulable(self):
+        cluster, plugin, engine, _ = make_env()
+        # only 1 of 3 created: PreFilter rejects (total < minAvailable)
+        cluster.create_pod(
+            shared_pod("solo", request="0.5", limit="1.0",
+                       group="team", headcount=3, threshold=1.0)
+        )
+        results = engine.run_until_idle()
+        assert all(r.result == "unschedulable" for r in results)
+
+    def test_gang_timeout_rolls_back(self):
+        cluster, plugin, engine, clock = make_env(nodes=("host-a",))
+        # 2 pods present (>= threshold*headcount = 2) but only 1 chip's worth
+        # of capacity free for the second, so the barrier can't complete
+        for i in range(2):
+            cluster.create_pod(
+                shared_pod(f"g{i}", request="3.0", limit="3.0",
+                           group="gang", headcount=2, threshold=1.0)
+            )
+        results = engine.run_until_idle()
+        waiting = [r for r in results if r.result == "waiting"]
+        assert waiting  # first reserved 3 chips, second can't fit
+        assert engine.waiting_count() == 1
+        clock.advance(10)  # past 2s * headcount
+        engine.expire_waiting_pods()
+        assert engine.waiting_count() == 0
+        # rolled back: all chips free again, pod unbound and stripped
+        g0 = cluster.get_pod("default", "g0")
+        assert not g0.is_bound()
+        assert constants.POD_GPU_UUID not in g0.annotations
+        root = plugin.allocator.leaf_cells["host-a-tpu-0"].parent
+        assert root.available == 4.0
+
+    def test_gang_threshold(self):
+        cluster, plugin, engine, _ = make_env()
+        # headcount 4, threshold 0.5 -> minAvailable 2
+        for i in range(2):
+            cluster.create_pod(
+                shared_pod(f"t{i}", request="0.5", limit="1.0",
+                           group="half", headcount=4, threshold=0.5)
+            )
+        results = engine.run_until_idle()
+        assert sum(1 for r in results if r.result == "bound") >= 1
+        assert all(p.is_bound() for p in cluster.list_pods())
+
+    def test_queue_sort_priority_first(self):
+        cluster, plugin, engine, _ = make_env(nodes=("host-a",))
+        cluster.create_pod(shared_pod("low", request="0.5", limit="1.0", priority="1"))
+        cluster.create_pod(shared_pod("high", request="0.5", limit="1.0", priority="90"))
+        pending = engine.pending_pods()
+        assert pending[0].name == "high"
+
+
+class TestRecovery:
+    def test_bound_pod_recovery(self):
+        # first scheduler places the pod...
+        cluster, plugin, engine, clock = make_env(nodes=("host-a",))
+        cluster.create_pod(shared_pod("p", request="0.5", limit="1.0", mem=1 << 30))
+        engine.run_until_idle()
+        bound = cluster.get_pod("default", "p")
+        uuid = bound.annotations[constants.POD_GPU_UUID]
+        port = int(bound.annotations[constants.POD_MANAGER_PORT])
+
+        # ...then a fresh scheduler process comes up on the same cluster
+        plugin2 = KubeShareScheduler(
+            topology=load_config(text=TOPOLOGY),
+            cluster=cluster,
+            inventory=lambda node: INVENTORY.get(node, []),
+            clock=clock,
+        )
+        engine2 = SchedulerEngine(plugin2, cluster, clock)
+        # recovery drains on the next Filter touching that node
+        cluster.create_pod(shared_pod("q", request="0.6", limit="1.0", mem=1 << 30))
+        engine2.run_until_idle()
+        leaf = plugin2.allocator.leaf_cells[uuid]
+        # 0.5 re-reserved for p plus q placed somewhere
+        q = cluster.get_pod("default", "q")
+        expected = 0.5 if q.annotations[constants.POD_GPU_UUID] != uuid else 1.1
+        assert abs((1.0 - leaf.available) - expected) < 1e-9
+        assert plugin2.port_bitmaps["host-a"].is_masked(
+            port - constants.POD_MANAGER_PORT_START
+        )
+
+    def test_node_failure_invalidates(self):
+        cluster, plugin, engine, _ = make_env(nodes=("host-a", "host-b"))
+        node = Node(name="host-a", labels={constants.NODE_LABEL_FILTER: "true"},
+                    ready=False)
+        cluster.update_node(node)
+        cluster.create_pod(shared_pod("p", request="0.5", limit="1.0"))
+        [result] = engine.run_until_idle()
+        assert result.node == "host-b"
+
+
+class TestReviewRegressions:
+    """Regressions for code-review findings on the scheduler milestone."""
+
+    def test_malformed_priority_does_not_wedge_queue(self):
+        cluster, plugin, engine, _ = make_env(nodes=("host-a",))
+        cluster.create_pod(Pod(name="bad",
+                               labels={constants.POD_PRIORITY: "high",
+                                       constants.POD_GPU_LIMIT: "1"},
+                               scheduler_name=constants.SCHEDULER_NAME))
+        cluster.create_pod(shared_pod("good", request="0.5", limit="1.0"))
+        engine.run_until_idle()
+        assert cluster.get_pod("default", "good").is_bound()
+        assert not cluster.get_pod("default", "bad").is_bound()
+
+    def test_fractional_release_restores_whole_chip(self):
+        cluster, plugin, engine, _ = make_env(nodes=("host-a",))
+        for name, req in [("a", "0.3"), ("b", "0.1")]:
+            cluster.create_pod(shared_pod(name, request=req, limit="1.0", mem=1))
+        engine.run_until_idle()
+        uuid = cluster.get_pod("default", "a").annotations[constants.POD_GPU_UUID]
+        cluster.delete_pod("default", "a")
+        cluster.delete_pod("default", "b")
+        leaf = plugin.allocator.leaf_cells[uuid]
+        assert leaf.available == 1.0 and leaf.available_whole_cell == 1
+        # whole chip usable again
+        cluster.create_pod(shared_pod("whole", request="1.0", limit="1.0"))
+        assert engine.run_until_idle()[-1].result == "bound"
+
+    def test_failed_gang_member_keeps_group(self):
+        cluster, plugin, engine, _ = make_env(nodes=("host-a",))
+        for i in range(2):
+            cluster.create_pod(shared_pod(f"g{i}", request="0.2", limit="1.0",
+                                          group="gg", headcount=2, threshold=0.5))
+        engine.run_until_idle()
+        cluster.set_pod_phase("default", "g0", PodPhase.FAILED)
+        assert plugin.pod_groups.get("default/gg") is not None
+        cluster.delete_pod("default", "g1")
+        cluster.delete_pod("default", "g0")
+        assert plugin.pod_groups.get("default/gg") is None
+
+    def test_shadow_mode_keeps_reservation(self):
+        cluster, plugin, engine, _ = make_env(bind_mode="shadow", nodes=("host-a",))
+        cluster.create_pod(shared_pod("s", request="0.5", limit="1.0"))
+        engine.run_until_idle()
+        pod = cluster.get_pod("default", "s")
+        leaf = plugin.allocator.leaf_cells[pod.annotations[constants.POD_GPU_UUID]]
+        assert leaf.available == 0.5
+        assert "default/s" in plugin.pod_status
