@@ -1,0 +1,3 @@
+from .aggregator import Aggregator
+
+__all__ = ["Aggregator"]

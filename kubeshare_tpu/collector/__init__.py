@@ -1,0 +1,3 @@
+from .collector import Collector, FakeEnumerator, JaxEnumerator, PromInventory
+
+__all__ = ["Collector", "FakeEnumerator", "JaxEnumerator", "PromInventory"]

@@ -1,0 +1,3 @@
+from .configd import ConfigDaemon, write_scheduler_ip
+
+__all__ = ["ConfigDaemon", "write_scheduler_ip"]

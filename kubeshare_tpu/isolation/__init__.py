@@ -1,0 +1,11 @@
+from .client import TokenClient, NativeTokenClient, connect_from_env
+from .guard import ExecutionGuard, apply_hbm_cap, token_gated
+
+__all__ = [
+    "TokenClient",
+    "NativeTokenClient",
+    "connect_from_env",
+    "ExecutionGuard",
+    "apply_hbm_cap",
+    "token_gated",
+]

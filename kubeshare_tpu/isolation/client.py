@@ -1,0 +1,184 @@
+"""Token-protocol clients: the in-process half of runtime isolation.
+
+Speaks the tokend/pmgr wire protocol (see native/tokend.cc).  Two
+implementations with one interface:
+
+- ``TokenClient``: pure Python sockets — the default for JAX workloads
+  (in-process gating; no LD_PRELOAD required).
+- ``NativeTokenClient``: ctypes over ``libtpushare_client.so`` — the same C
+  code the PJRT interposer uses, for bit-identical behavior with the
+  LD_PRELOAD path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+from typing import Optional, Tuple
+
+from .. import constants
+
+
+class TokenClient:
+    def __init__(self, host: str, port: int, pod_name: str, timeout: float = 60.0):
+        self.host = host
+        self.port = port
+        self.pod_name = pod_name
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._file = None
+
+    # -- wire ----------------------------------------------------------
+    def _connect(self) -> None:
+        if self._sock is not None:
+            return
+        sock = socket.create_connection((self.host, self.port), timeout=self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+        self._file = sock.makefile("rw", newline="\n")
+
+    def _round_trip(self, request: str) -> str:
+        for _ in range(2):
+            try:
+                self._connect()
+                assert self._file is not None
+                self._file.write(request)
+                self._file.flush()
+                reply = self._file.readline()
+                if reply:
+                    return reply.strip()
+            except OSError:
+                pass
+            self.close()
+        raise ConnectionError(f"token endpoint {self.host}:{self.port} unreachable")
+
+    # -- protocol ------------------------------------------------------
+    def acquire(self, est_ms: float = 0.0) -> float:
+        """Block until granted a compute token; returns the quota in ms."""
+        reply = self._round_trip(f"REQ {self.pod_name} {est_ms:.3f}\n")
+        if not reply.startswith("TOK "):
+            raise ConnectionError(f"unexpected token reply: {reply!r}")
+        return float(reply[4:])
+
+    def release(self, used_ms: float) -> None:
+        self._round_trip(f"RET {self.pod_name} {used_ms:.3f}\n")
+
+    def request_memory(self, delta_bytes: int) -> Tuple[bool, int, int]:
+        """Account an HBM delta; returns (granted, used, cap)."""
+        reply = self._round_trip(f"MEM {self.pod_name} {delta_bytes}\n")
+        parts = reply.split()
+        if not parts or parts[0] not in ("OK", "DENY"):
+            raise ConnectionError(f"unexpected mem reply: {reply!r}")
+        ok = parts[0] == "OK"
+        used = int(parts[1]) if len(parts) > 1 else 0
+        cap = int(parts[2]) if len(parts) > 2 else 0
+        return ok, used, cap
+
+    def stat(self) -> str:
+        return self._round_trip("STAT\n")
+
+    def ping(self) -> None:
+        """Eagerly verify the broker is reachable (raises ConnectionError)."""
+        try:
+            self._connect()
+        except OSError as e:
+            raise ConnectionError(
+                f"token endpoint {self.host}:{self.port} unreachable"
+            ) from e
+
+    def close(self) -> None:
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+
+class NativeTokenClient:
+    """ctypes binding over the C client (native/shim/client.cc)."""
+
+    def __init__(self, host: str, port: int, pod_name: str,
+                 library_path: Optional[str] = None):
+        path = library_path or _find_client_library()
+        if path is None:
+            raise RuntimeError(
+                "libtpushare_client.so not found; run `make -C native`"
+            )
+        lib = ctypes.CDLL(path)
+        lib.tpushare_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p]
+        lib.tpushare_connect.restype = ctypes.c_int
+        lib.tpushare_acquire.argtypes = [ctypes.c_double]
+        lib.tpushare_acquire.restype = ctypes.c_double
+        lib.tpushare_release.argtypes = [ctypes.c_double]
+        lib.tpushare_release.restype = ctypes.c_int
+        lib.tpushare_mem_request.argtypes = [ctypes.c_longlong]
+        lib.tpushare_mem_request.restype = ctypes.c_int
+        self._lib = lib
+        self.pod_name = pod_name
+        if lib.tpushare_connect(host.encode(), port, pod_name.encode()) != 0:
+            raise ConnectionError(f"token endpoint {host}:{port} unreachable")
+
+    def acquire(self, est_ms: float = 0.0) -> float:
+        quota = self._lib.tpushare_acquire(est_ms)
+        if quota < 0:
+            raise ConnectionError("token acquire failed")
+        return quota
+
+    def release(self, used_ms: float) -> None:
+        self._lib.tpushare_release(used_ms)
+
+    def request_memory(self, delta_bytes: int) -> Tuple[bool, int, int]:
+        result = self._lib.tpushare_mem_request(delta_bytes)
+        if result < 0:
+            raise ConnectionError("mem request failed")
+        return bool(result), 0, 0
+
+    def close(self) -> None:
+        self._lib.tpushare_disconnect()
+
+
+def _find_client_library() -> Optional[str]:
+    candidates = (
+        os.path.join(
+            os.path.dirname(__file__), "..", "..", "native", "build",
+            "libtpushare_client.so",
+        ),
+        os.path.join(constants.LIBRARY_PATH, "libtpushare_client.so"),
+    )
+    for path in candidates:
+        path = os.path.abspath(path)
+        if os.path.isfile(path):
+            return path
+    return None
+
+
+def connect_from_env(native: bool = False) -> Optional[TokenClient]:
+    """Build a client from the scheduler-injected env (POD_MANAGER_PORT /
+    POD_NAME), mirroring the shim's endpoint resolution.  Returns None when
+    the pod is not token-managed (whole-chip or regular pods)."""
+    port = os.environ.get(constants.ENV_POD_MANAGER_PORT)
+    if not port:
+        return None
+    pod_name = os.environ.get(constants.ENV_POD_NAME, "unknown/unknown")
+    host = os.environ.get("POD_MANAGER_IP", "")
+    if not host:
+        ip_file = os.environ.get(
+            "TPUSHARE_SCHEDULER_IP_FILE", constants.SCHEDULER_IP_FILE
+        )
+        try:
+            host = open(ip_file).read().strip()
+        except OSError:
+            host = "127.0.0.1"
+    if native:
+        return NativeTokenClient(host or "127.0.0.1", int(port), pod_name)
+    client = TokenClient(host or "127.0.0.1", int(port), pod_name)
+    client.ping()  # surface an unreachable broker at setup, not mid-training
+    return client

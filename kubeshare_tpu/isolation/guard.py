@@ -1,0 +1,131 @@
+"""Execution gating + HBM caps for JAX workloads.
+
+The TPU-native enforcement points (SURVEY §7.2):
+
+- **Compute share**: XLA dispatches whole compiled programs, so the guard
+  brackets each step — acquire a token from the chip's tokend, run the
+  jitted step, ``block_until_ready``, release with measured wall time.
+  This is the in-process equivalent of the PJRT interposer's Execute hook
+  (and what Gemini did per kernel burst).
+- **HBM cap**: TPU clients allocate most HBM at client init, so the cap must
+  land *before* jax initializes (SURVEY §7.4) — ``apply_hbm_cap`` translates
+  the scheduler-injected TPUSHARE_MEM_FRACTION into XLA client flags.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Optional, TypeVar
+
+from .. import constants
+from ..utils.logger import get_logger
+from .client import TokenClient, connect_from_env
+
+F = TypeVar("F", bound=Callable)
+
+
+def apply_hbm_cap(environ: Optional[dict] = None) -> Optional[float]:
+    """Install the pod's HBM cap into the XLA client config.  MUST run
+    before ``import jax`` triggers backend init.  Returns the fraction
+    applied, or None when uncapped."""
+    env = environ if environ is not None else os.environ
+    fraction_raw = env.get(constants.ENV_MEM_FRACTION)
+    if not fraction_raw:
+        return None
+    try:
+        fraction = float(fraction_raw)
+    except ValueError:
+        return None
+    if not 0.0 < fraction <= 1.0:
+        return None
+    # JAX reads these at backend init: cap the client allocator to the pod's
+    # share and keep preallocation off so co-tenants can start in any order.
+    env.setdefault("XLA_PYTHON_CLIENT_MEM_FRACTION", f"{fraction:.4f}")
+    env.setdefault("XLA_PYTHON_CLIENT_PREALLOCATE", "false")
+    return fraction
+
+
+class ExecutionGuard:
+    """Token-gates callables that dispatch work to the shared chip.
+
+    Degrades gracefully: with no broker configured (solo run, tests) the
+    guard is a no-op passthrough, so the same training script runs managed
+    and unmanaged.
+    """
+
+    def __init__(self, client: Optional[TokenClient] = None,
+                 from_env: bool = True) -> None:
+        self.log = get_logger("tpushim")
+        if client is None and from_env:
+            try:
+                client = connect_from_env()
+            except ConnectionError as e:
+                self.log.warning("token broker unreachable, running ungated: %s", e)
+                client = None
+        self.client = client
+        self._estimate_ms = 1.0  # EMA of step wall time
+        self.tokens_acquired = 0
+        self.total_gated_ms = 0.0
+
+    @property
+    def gated(self) -> bool:
+        return self.client is not None
+
+    def __call__(self, fn: F) -> F:
+        if self.client is None:
+            return fn
+
+        def gated(*args: Any, **kwargs: Any) -> Any:
+            self.acquire()
+            start = time.monotonic()
+            try:
+                result = fn(*args, **kwargs)
+                result = _block_until_ready(result)
+            finally:
+                elapsed_ms = (time.monotonic() - start) * 1e3
+                self.release(elapsed_ms)
+            return result
+
+        gated.__name__ = getattr(fn, "__name__", "gated")
+        return gated  # type: ignore[return-value]
+
+    def acquire(self) -> float:
+        if self.client is None:
+            return 0.0
+        quota = self.client.acquire(self._estimate_ms)
+        self.tokens_acquired += 1
+        return quota
+
+    def release(self, elapsed_ms: float) -> None:
+        if self.client is None:
+            return
+        self._estimate_ms = 0.8 * self._estimate_ms + 0.2 * elapsed_ms
+        self.total_gated_ms += elapsed_ms
+        self.client.release(elapsed_ms)
+
+    def request_memory(self, delta_bytes: int) -> bool:
+        if self.client is None:
+            return True
+        ok, used, cap = self.client.request_memory(delta_bytes)
+        if not ok:
+            self.log.warning(
+                "HBM request denied: used %d + %d > cap %d", used, delta_bytes, cap
+            )
+        return ok
+
+
+def _block_until_ready(result: Any) -> Any:
+    """Wait for device completion so the measured time covers the real
+    execution burst, not just async dispatch."""
+    try:
+        import jax
+
+        return jax.block_until_ready(result)
+    except ImportError:
+        return result
+
+
+def token_gated(fn: F) -> F:
+    """Decorator: gate a step function with an env-configured guard."""
+    return ExecutionGuard()(fn)

@@ -1,0 +1,195 @@
+"""Per-chip runtime supervisor (ref docker/kubeshare-gemini-scheduler/
+launcher.py).
+
+One supervisor per TPU chip: starts the native ``tpushare-tokend`` for the
+chip, watches the chip's podmanagerport file, and reconciles the set of
+``tpushare-pmgr`` broker processes (spawn on new pods, kill on removal —
+ref launcher.py:34-67).  Polling replaces inotify on the Python side (the
+C++ tokend has inotify for its own config); the file is atomically renamed
+into place so a poll never sees a torn write.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from .. import constants
+from ..utils.logger import get_logger
+
+_BINARY_DIRS = (
+    os.path.join(os.path.dirname(__file__), "..", "..", "native", "build"),
+    "/kubeshare/library",
+    "/usr/local/bin",
+)
+
+
+def find_binary(name: str) -> Optional[str]:
+    for directory in _BINARY_DIRS:
+        path = os.path.abspath(os.path.join(directory, name))
+        if os.path.isfile(path) and os.access(path, os.X_OK):
+            return path
+    return None
+
+
+class ChipSupervisor:
+    def __init__(
+        self,
+        chip_uuid: str,
+        config_dir: str = constants.CHIP_CONFIG_DIR,
+        port_dir: str = constants.POD_MANAGER_PORT_DIR,
+        tokend_port: int = constants.TOKEND_BASE_PORT,
+        base_quota_ms: float = constants.TOKEN_BASE_QUOTA_MS,
+        min_quota_ms: float = constants.TOKEN_MIN_QUOTA_MS,
+        window_ms: float = constants.TOKEN_WINDOW_MS,
+        tokend_binary: Optional[str] = None,
+        pmgr_binary: Optional[str] = None,
+        poll_interval: float = 0.5,
+        log_dir: Optional[str] = None,
+    ) -> None:
+        self.chip_uuid = chip_uuid
+        self.config_dir = config_dir
+        self.port_dir = port_dir
+        self.tokend_port = tokend_port
+        self.base_quota_ms = base_quota_ms
+        self.min_quota_ms = min_quota_ms
+        self.window_ms = window_ms
+        self.tokend_binary = tokend_binary or find_binary("tpushare-tokend")
+        self.pmgr_binary = pmgr_binary or find_binary("tpushare-pmgr")
+        self.poll_interval = poll_interval
+        self.log = get_logger("kubeshare-launcher", log_dir=log_dir)
+
+        self.tokend: Optional[subprocess.Popen] = None
+        # "ns/name port" line -> (alive_flag, process)
+        self.pod_managers: Dict[str, subprocess.Popen] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        if self.tokend_binary is None:
+            raise RuntimeError("tpushare-tokend binary not found; run `make -C native`")
+        os.makedirs(self.config_dir, exist_ok=True)
+        os.makedirs(self.port_dir, exist_ok=True)
+        config_path = os.path.join(self.config_dir, self.chip_uuid)
+        if not os.path.exists(config_path):
+            with open(config_path, "w") as f:
+                f.write("0\n")
+        self.tokend = subprocess.Popen(
+            [
+                self.tokend_binary,
+                "-p", self.config_dir,
+                "-f", self.chip_uuid,
+                "-P", str(self.tokend_port),
+                "-q", str(self.base_quota_ms),
+                "-m", str(self.min_quota_ms),
+                "-w", str(self.window_ms),
+            ],
+            start_new_session=True,
+        )
+        self.reconcile()
+        self._thread = threading.Thread(target=self._watch_loop, daemon=True)
+        self._thread.start()
+
+    def _watch_loop(self) -> None:
+        path = os.path.join(self.port_dir, self.chip_uuid)
+        last_mtime = 0.0
+        while not self._stop.is_set():
+            try:
+                mtime = os.stat(path).st_mtime
+            except OSError:
+                mtime = 0.0
+            if mtime != last_mtime:
+                last_mtime = mtime
+                try:
+                    self.reconcile()
+                except Exception as e:  # tolerate torn/partial content
+                    self.log.warning("reconcile failed: %s", e)
+            self._stop.wait(self.poll_interval)
+
+    # ------------------------------------------------------------------
+    def read_port_file(self) -> Dict[str, str]:
+        """Parse the podmanagerport file into {pod_key: port}
+        (ref launcher.py:34-46)."""
+        path = os.path.join(self.port_dir, self.chip_uuid)
+        try:
+            with open(path) as f:
+                lines = f.read().splitlines()
+        except OSError:
+            return {}
+        if not lines:
+            return {}
+        try:
+            count = int(lines[0])
+        except ValueError:
+            return {}
+        entries: Dict[str, str] = {}
+        for line in lines[1 : count + 1]:
+            parts = line.split()
+            if len(parts) == 2:
+                entries[parts[0]] = parts[1]
+        return entries
+
+    def reconcile(self) -> None:
+        """Spawn/kill pmgr processes to match the port file
+        (ref launcher.py:47-67)."""
+        desired = self.read_port_file()
+        desired_keys = {f"{pod} {port}" for pod, port in desired.items()}
+        # kill removed
+        for key in list(self.pod_managers):
+            if key not in desired_keys:
+                proc = self.pod_managers.pop(key)
+                self._kill(proc)
+                self.log.info("pod manager %r stopped", key)
+        # spawn new
+        if self.pmgr_binary is None:
+            return
+        for pod, port in desired.items():
+            key = f"{pod} {port}"
+            if key in self.pod_managers:
+                continue
+            env = dict(
+                os.environ,
+                SCHEDULER_IP="127.0.0.1",
+                SCHEDULER_PORT=str(self.tokend_port),
+                POD_MANAGER_IP="0.0.0.0",
+                POD_MANAGER_PORT=str(port),
+                POD_NAME=pod,
+            )
+            self.pod_managers[key] = subprocess.Popen(
+                [self.pmgr_binary], env=env, start_new_session=True
+            )
+            self.log.info("pod manager %r started on port %s", pod, port)
+
+    # ------------------------------------------------------------------
+    def _kill(self, proc: subprocess.Popen) -> None:
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for proc in self.pod_managers.values():
+            self._kill(proc)
+        self.pod_managers.clear()
+        if self.tokend is not None:
+            self._kill(self.tokend)
+            self.tokend = None
+
+    def __enter__(self) -> "ChipSupervisor":
+        self.start()
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self.stop()

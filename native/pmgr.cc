@@ -1,0 +1,160 @@
+// tpushare-pmgr — per-pod manager/broker (the gem-pmgr equivalent).
+//
+// One instance per shared pod, listening on the scheduler-assigned
+// POD_MANAGER_PORT (ref SURVEY §2.9).  In-container shims connect here; the
+// broker stamps the pod's identity onto every request (a container cannot
+// impersonate another pod) and relays to the per-chip tokend.
+//
+// Env (parity with the reference launcher's child env,
+// ref docker/kubeshare-gemini-scheduler/launcher.py:13-20):
+//   SCHEDULER_IP / SCHEDULER_PORT    tokend endpoint
+//   POD_MANAGER_IP / POD_MANAGER_PORT listen endpoint
+//   POD_NAME                          "<ns>/<name>" stamped on requests
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdlib>
+#include <cstring>
+#include <iostream>
+#include <sstream>
+#include <string>
+#include <thread>
+
+namespace {
+
+bool ReadLine(int fd, std::string* line) {
+  line->clear();
+  char c;
+  while (true) {
+    ssize_t n = recv(fd, &c, 1, 0);
+    if (n <= 0) return false;
+    if (c == '\n') return true;
+    line->push_back(c);
+  }
+}
+
+bool WriteAll(int fd, const std::string& data) {
+  size_t off = 0;
+  while (off < data.size()) {
+    ssize_t n = send(fd, data.data() + off, data.size() - off, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    off += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+int ConnectTo(const std::string& ip, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, ip.c_str(), &addr.sin_addr) != 1) {
+    close(fd);
+    return -1;
+  }
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+struct Config {
+  std::string scheduler_ip = "127.0.0.1";
+  int scheduler_port = 49901;
+  std::string listen_ip = "0.0.0.0";
+  int listen_port = 50051;
+  std::string pod_name = "unknown/unknown";
+};
+
+// Rewrite "<CMD> <pod> <rest>" to carry our pod identity; STAT passes as-is.
+std::string StampIdentity(const std::string& line, const std::string& pod) {
+  std::istringstream in(line);
+  std::string cmd;
+  in >> cmd;
+  if (cmd == "STAT") return "STAT\n";
+  std::string ignored_pod;
+  in >> ignored_pod;
+  std::string rest;
+  std::getline(in, rest);
+  return cmd + " " + pod + rest + "\n";
+}
+
+void ServeClient(int client_fd, const Config& cfg) {
+  int one = 1;
+  setsockopt(client_fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  int upstream = ConnectTo(cfg.scheduler_ip, cfg.scheduler_port);
+  if (upstream < 0) {
+    WriteAll(client_fd, "ERR no scheduler\n");
+    close(client_fd);
+    return;
+  }
+  std::string line;
+  while (ReadLine(client_fd, &line)) {
+    if (!WriteAll(upstream, StampIdentity(line, cfg.pod_name))) break;
+    std::string reply;
+    if (!ReadLine(upstream, &reply)) break;
+    if (!WriteAll(client_fd, reply + "\n")) break;
+  }
+  close(upstream);  // tokend's Abandon handles a dropped token holder
+  close(client_fd);
+}
+
+std::string EnvOr(const char* name, const std::string& fallback) {
+  const char* v = std::getenv(name);
+  return (v != nullptr && *v != '\0') ? std::string(v) : fallback;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Config cfg;
+  cfg.scheduler_ip = EnvOr("SCHEDULER_IP", cfg.scheduler_ip);
+  cfg.scheduler_port = std::atoi(EnvOr("SCHEDULER_PORT", "49901").c_str());
+  cfg.listen_ip = EnvOr("POD_MANAGER_IP", cfg.listen_ip);
+  cfg.listen_port = std::atoi(EnvOr("POD_MANAGER_PORT", "50051").c_str());
+  cfg.pod_name = EnvOr("POD_NAME", cfg.pod_name);
+  for (int i = 1; i < argc - 1; i++) {
+    std::string flag = argv[i];
+    if (flag == "-P") cfg.listen_port = std::atoi(argv[++i]);
+    else if (flag == "-s") cfg.scheduler_ip = argv[++i];
+    else if (flag == "-p") cfg.scheduler_port = std::atoi(argv[++i]);
+    else if (flag == "-n") cfg.pod_name = argv[++i];
+  }
+
+  int server = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(server, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(cfg.listen_port));
+  if (inet_pton(AF_INET, cfg.listen_ip.c_str(), &addr.sin_addr) != 1) {
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  }
+  if (bind(server, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(server, 16) != 0) {
+    std::cerr << "tpushare-pmgr: bind/listen " << cfg.listen_port << ": "
+              << strerror(errno) << "\n";
+    return 1;
+  }
+  std::cerr << "tpushare-pmgr: pod " << cfg.pod_name << " on port "
+            << cfg.listen_port << " -> tokend " << cfg.scheduler_ip << ":"
+            << cfg.scheduler_port << "\n";
+  while (true) {
+    int fd = accept(server, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    std::thread(ServeClient, fd, cfg).detach();
+  }
+  return 0;
+}

@@ -1,0 +1,189 @@
+// tpushare client library — token/memory protocol client (C ABI).
+//
+// The in-container half of the isolation runtime (ref SURVEY §2.9: the role
+// of libgemhook's TCP side).  Exposed as a plain C API so it is usable from
+// the PJRT interposer (libtpushim.so.1), from Python via ctypes (in-process
+// JAX gating, no LD_PRELOAD needed), and from tests.
+//
+// Endpoint resolution (tpushare_init_from_env):
+//   POD_MANAGER_PORT          broker port (scheduler-injected)
+//   POD_NAME                  "<ns>/<name>" (scheduler-injected)
+//   POD_MANAGER_IP            default 127.0.0.1 (node daemon is hostNetwork;
+//                             ref deploy/node-daemon.yaml:74)
+//   TPUSHARE_SCHEDULER_IP_FILE overrides the schedulerIP.txt path
+//                             (ref cmd/kubeshare-query-ip/main.go:22-34)
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+struct Client {
+  std::mutex mu;
+  int fd = -1;
+  std::string ip = "127.0.0.1";
+  int port = 0;
+  std::string pod = "unknown/unknown";
+
+  bool Connect() {
+    if (fd >= 0) return true;
+    if (port <= 0) return false;
+    int s = socket(AF_INET, SOCK_STREAM, 0);
+    if (s < 0) return false;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (inet_pton(AF_INET, ip.c_str(), &addr.sin_addr) != 1 ||
+        connect(s, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+      close(s);
+      return false;
+    }
+    int one = 1;
+    setsockopt(s, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    fd = s;
+    return true;
+  }
+
+  void Drop() {
+    if (fd >= 0) close(fd);
+    fd = -1;
+  }
+
+  bool SendLine(const std::string& line) {
+    size_t off = 0;
+    while (off < line.size()) {
+      ssize_t n = send(fd, line.data() + off, line.size() - off, MSG_NOSIGNAL);
+      if (n <= 0) return false;
+      off += static_cast<size_t>(n);
+    }
+    return true;
+  }
+
+  bool RecvLine(std::string* line) {
+    line->clear();
+    char c;
+    while (true) {
+      ssize_t n = recv(fd, &c, 1, 0);
+      if (n <= 0) return false;
+      if (c == '\n') return true;
+      line->push_back(c);
+    }
+  }
+
+  // one request/reply round trip with a single reconnect attempt
+  bool RoundTrip(const std::string& request, std::string* reply) {
+    for (int attempt = 0; attempt < 2; attempt++) {
+      if (!Connect()) return false;
+      if (SendLine(request) && RecvLine(reply)) return true;
+      Drop();
+    }
+    return false;
+  }
+};
+
+Client* g_client() {
+  static Client c;
+  return &c;
+}
+
+}  // namespace
+
+extern "C" {
+
+int tpushare_connect(const char* ip, int port, const char* pod_name) {
+  Client* c = g_client();
+  std::lock_guard<std::mutex> lock(c->mu);
+  c->Drop();
+  if (ip != nullptr && *ip) c->ip = ip;
+  c->port = port;
+  if (pod_name != nullptr && *pod_name) c->pod = pod_name;
+  return c->Connect() ? 0 : -1;
+}
+
+// Reads the scheduler-injected env; returns 0 when a broker is configured.
+int tpushare_init_from_env(void) {
+  const char* port = std::getenv("POD_MANAGER_PORT");
+  if (port == nullptr || *port == '\0') return -1;
+  const char* pod = std::getenv("POD_NAME");
+  const char* ip = std::getenv("POD_MANAGER_IP");
+  std::string host = (ip != nullptr && *ip) ? ip : "";
+  if (host.empty()) {
+    const char* path = std::getenv("TPUSHARE_SCHEDULER_IP_FILE");
+    std::string file = (path != nullptr && *path)
+                           ? path
+                           : "/kubeshare/library/schedulerIP.txt";
+    FILE* f = std::fopen(file.c_str(), "r");
+    if (f != nullptr) {
+      char buf[64] = {0};
+      if (std::fgets(buf, sizeof(buf), f) != nullptr) {
+        host = buf;
+        while (!host.empty() && (host.back() == '\n' || host.back() == ' '))
+          host.pop_back();
+      }
+      std::fclose(f);
+    }
+  }
+  if (host.empty()) host = "127.0.0.1";
+  return tpushare_connect(host.c_str(), std::atoi(port),
+                          pod != nullptr ? pod : "");
+}
+
+int tpushare_connected(void) {
+  Client* c = g_client();
+  std::lock_guard<std::mutex> lock(c->mu);
+  return c->fd >= 0 ? 1 : 0;
+}
+
+// Blocks until a token is granted; returns quota_ms, or <0 on error.
+double tpushare_acquire(double est_ms) {
+  Client* c = g_client();
+  std::lock_guard<std::mutex> lock(c->mu);
+  std::string reply;
+  char req[160];
+  std::snprintf(req, sizeof(req), "REQ %s %.3f\n", c->pod.c_str(), est_ms);
+  if (!c->RoundTrip(req, &reply)) return -1.0;
+  if (reply.rfind("TOK ", 0) != 0) return -2.0;
+  return std::atof(reply.c_str() + 4);
+}
+
+// Reports measured device time for the held token; 0 on success.
+int tpushare_release(double used_ms) {
+  Client* c = g_client();
+  std::lock_guard<std::mutex> lock(c->mu);
+  std::string reply;
+  char req[160];
+  std::snprintf(req, sizeof(req), "RET %s %.3f\n", c->pod.c_str(), used_ms);
+  if (!c->RoundTrip(req, &reply)) return -1;
+  return reply == "OK" ? 0 : -2;
+}
+
+// Accounts a memory delta against the pod's HBM cap.
+// Returns 1 granted, 0 denied, <0 error.
+int tpushare_mem_request(long long delta_bytes) {
+  Client* c = g_client();
+  std::lock_guard<std::mutex> lock(c->mu);
+  std::string reply;
+  char req[160];
+  std::snprintf(req, sizeof(req), "MEM %s %lld\n", c->pod.c_str(), delta_bytes);
+  if (!c->RoundTrip(req, &reply)) return -1;
+  if (reply.rfind("OK", 0) == 0) return 1;
+  if (reply.rfind("DENY", 0) == 0) return 0;
+  return -2;
+}
+
+void tpushare_disconnect(void) {
+  Client* c = g_client();
+  std::lock_guard<std::mutex> lock(c->mu);
+  c->Drop();
+}
+
+}  // extern "C"

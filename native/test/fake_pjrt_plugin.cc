@@ -1,0 +1,39 @@
+// Test fixture: a minimal PJRT plugin exporting GetPjrtApi with a live
+// Execute entry, used to verify the libtpushim interposer end-to-end
+// without TPU hardware (tests/test_native_runtime.py::TestInterposer).
+
+#include <cstdio>
+#include <cstring>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+int g_execute_calls = 0;
+
+PJRT_Error* FakeExecute(PJRT_LoadedExecutable_Execute_Args*) {
+  g_execute_calls++;
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+int fake_execute_calls(void) { return g_execute_calls; }
+
+const PJRT_Api* GetPjrtApi(void) {
+  static PJRT_Api api;
+  static bool initialized = false;
+  if (!initialized) {
+    std::memset(&api, 0, sizeof(api));
+    api.struct_size = PJRT_Api_STRUCT_SIZE;
+    api.pjrt_api_version.major_version = PJRT_API_MAJOR;
+    api.pjrt_api_version.minor_version = PJRT_API_MINOR;
+    api.PJRT_LoadedExecutable_Execute = FakeExecute;
+    initialized = true;
+  }
+  return &api;
+}
+
+}  // extern "C"

@@ -1,0 +1,44 @@
+// Test driver: loads the fake PJRT plugin the way JAX/PyTorch-XLA load
+// libtpu (dlopen + dlsym "GetPjrtApi") and runs N executions through the
+// returned API table.  Run with LD_PRELOAD=libtpushim.so.1 to verify the
+// interposer gates each Execute through the token runtime.
+//
+// usage: interposer_driver <plugin.so> <n_executions>
+
+#include <dlfcn.h>
+
+#include <cstdio>
+#include <cstdlib>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s <plugin.so> <n>\n", argv[0]);
+    return 2;
+  }
+  void* handle = dlopen(argv[1], RTLD_NOW);
+  if (handle == nullptr) {
+    std::fprintf(stderr, "dlopen: %s\n", dlerror());
+    return 1;
+  }
+  auto get_api = reinterpret_cast<const PJRT_Api* (*)()>(
+      dlsym(handle, "GetPjrtApi"));
+  if (get_api == nullptr) {
+    std::fprintf(stderr, "dlsym GetPjrtApi failed\n");
+    return 1;
+  }
+  const PJRT_Api* api = get_api();
+  if (api == nullptr || api->PJRT_LoadedExecutable_Execute == nullptr) {
+    std::fprintf(stderr, "no api or execute\n");
+    return 1;
+  }
+  int n = std::atoi(argv[2]);
+  PJRT_LoadedExecutable_Execute_Args args;
+  for (int i = 0; i < n; i++) {
+    api->PJRT_LoadedExecutable_Execute(&args);
+  }
+  auto calls = reinterpret_cast<int (*)()>(dlsym(handle, "fake_execute_calls"));
+  std::printf("executed %d real_calls %d\n", n, calls != nullptr ? calls() : -1);
+  return 0;
+}

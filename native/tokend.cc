@@ -1,0 +1,461 @@
+// tpushare-tokend — per-chip token scheduler (the gem-schd equivalent).
+//
+// One instance per TPU chip arbitrates compute time between the pods sharing
+// that chip (ref SURVEY §2.9: Gemini's gem-schd grants time-quota tokens so
+// each pod gets >= request and <= limit of device time over a sliding
+// window).  Design is TPU-native rather than a port: XLA dispatches whole
+// compiled programs, so the unit of accounting is an execution burst -- the
+// client acquires a token before dispatching, reports measured device time
+// on release, and usage decays exponentially with time constant `window`
+// (a smooth sliding window).
+//
+// CLI (parity with the reference launcher, ref
+// docker/kubeshare-gemini-scheduler/launcher.py:22-32):
+//   tpushare-tokend -p <config_dir> -f <config_file> -P <port>
+//                   -q <base_quota_ms> -m <min_quota_ms> -w <window_ms>
+//
+// Config file (written by configd, ref pkg/config/query.go:70-105):
+//   line 1: N
+//   N x  "<ns>/<name> <limit> <request> <memory_bytes>"
+// Reloaded on inotify IN_CLOSE_WRITE/IN_MOVED_TO (atomic-rename friendly)
+// with mtime polling as fallback.
+//
+// Wire protocol (line-based TCP; pmgr proxies and stamps pod identity):
+//   REQ <pod> <est_ms>   -> TOK <quota_ms>        (blocks until granted)
+//   RET <pod> <used_ms>  -> OK
+//   MEM <pod> <delta>    -> OK <used> <cap> | DENY <used> <cap>
+//   STAT                 -> one JSON line
+//
+// Scheduling policy: exclusive token (one pod drives the chip at a time).
+// Pick among eligible waiters: pods under their guaranteed share
+// (used/window < request) first, by largest deficit; then work-conserving
+// by smallest used/limit.  Over-limit pods wait for decay.  Quota shrinks
+// from base toward min as the number of active pods grows.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/inotify.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <condition_variable>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+double NowMs() {
+  return std::chrono::duration<double, std::milli>(
+             Clock::now().time_since_epoch())
+      .count();
+}
+
+struct PodQuota {
+  double limit = 1.0;
+  double request = 0.0;
+  long long mem_cap = 0;
+  // accounting
+  double used_ms = 0.0;     // decayed usage within the window
+  double last_decay = 0.0;  // ms timestamp of last decay application
+  long long mem_used = 0;
+  long long grants = 0;
+  bool in_config = true;
+};
+
+struct Options {
+  std::string config_dir;
+  std::string config_file;
+  int port = 49901;
+  double base_quota = 300.0;
+  double min_quota = 20.0;
+  double window = 10000.0;
+};
+
+class TokenScheduler {
+ public:
+  explicit TokenScheduler(const Options& opt) : opt_(opt) {}
+
+  void LoadConfig(const std::string& path) {
+    std::ifstream in(path);
+    if (!in) return;
+    int n = 0;
+    if (!(in >> n)) return;
+    std::lock_guard<std::mutex> lock(mu_);
+    for (auto& kv : pods_) kv.second.in_config = false;
+    for (int i = 0; i < n; i++) {
+      std::string name, limit, request, memory;
+      if (!(in >> name >> limit >> request >> memory)) break;
+      PodQuota& q = pods_[name];
+      q.in_config = true;
+      try {
+        q.limit = std::stod(limit);
+        q.request = std::stod(request);
+        q.mem_cap = std::stoll(memory);
+      } catch (...) {
+        continue;
+      }
+      if (q.limit <= 0.0) q.limit = 1.0;
+    }
+    // drop pods no longer configured and not holding the token
+    for (auto it = pods_.begin(); it != pods_.end();) {
+      if (!it->second.in_config && holder_ != it->first) {
+        it = pods_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    cv_.notify_all();
+  }
+
+  // Blocks until this pod is granted the token; returns quota in ms.
+  double Acquire(const std::string& pod, double est_ms) {
+    std::unique_lock<std::mutex> lock(mu_);
+    waiters_++;
+    // wait_for (not wait): eligibility can be restored purely by time
+    // passing (usage decay), which nothing notifies about
+    while (true) {
+      DecayAllLocked();
+      if (holder_.empty() && Eligible(pod) && IsChosen(pod)) break;
+      cv_.wait_for(lock, std::chrono::milliseconds(20));
+    }
+    waiters_--;
+    holder_ = pod;
+    PodQuota& q = Ensure(pod);
+    q.grants++;
+    double quota = QuotaFor(q, est_ms);
+    outstanding_quota_ = quota;
+    grant_time_ = NowMs();
+    return quota;
+  }
+
+  void Release(const std::string& pod, double used_ms) {
+    std::lock_guard<std::mutex> lock(mu_);
+    if (holder_ != pod) return;
+    PodQuota& q = Ensure(pod);
+    DecayLocked(q);
+    // trust the measured device time but charge at least a fraction of the
+    // grant — a client that always reports 0 would otherwise stay
+    // perpetually under its request and monopolize the chip
+    double hold_ms = NowMs() - grant_time_;
+    double floor_ms = std::min(0.05 * outstanding_quota_, hold_ms);
+    double charge = std::max(used_ms, floor_ms);
+    q.used_ms += charge;
+    holder_.clear();
+    outstanding_quota_ = 0;
+    cv_.notify_all();
+  }
+
+  // Connection died while holding the token: charge full quota.
+  void Abandon(const std::string& pod) {
+    std::lock_guard<std::mutex> lock(mu_);
+    if (holder_ != pod) return;
+    Ensure(pod).used_ms += outstanding_quota_;
+    holder_.clear();
+    outstanding_quota_ = 0;
+    cv_.notify_all();
+  }
+
+  // MEM accounting: returns {ok, used, cap}.
+  std::tuple<bool, long long, long long> Mem(const std::string& pod,
+                                             long long delta) {
+    std::lock_guard<std::mutex> lock(mu_);
+    PodQuota& q = Ensure(pod);
+    long long next = q.mem_used + delta;
+    if (next < 0) next = 0;
+    if (q.mem_cap > 0 && next > q.mem_cap) {
+      return {false, q.mem_used, q.mem_cap};
+    }
+    q.mem_used = next;
+    return {true, q.mem_used, q.mem_cap};
+  }
+
+  std::string Stat() {
+    std::lock_guard<std::mutex> lock(mu_);
+    DecayAllLocked();
+    std::ostringstream out;
+    out << "{\"holder\":\"" << holder_ << "\",\"waiters\":" << waiters_
+        << ",\"pods\":{";
+    bool first = true;
+    for (auto& kv : pods_) {
+      if (!first) out << ",";
+      first = false;
+      out << "\"" << kv.first << "\":{\"share\":"
+          << kv.second.used_ms / opt_.window
+          << ",\"request\":" << kv.second.request
+          << ",\"limit\":" << kv.second.limit
+          << ",\"mem_used\":" << kv.second.mem_used
+          << ",\"mem_cap\":" << kv.second.mem_cap
+          << ",\"grants\":" << kv.second.grants << "}";
+    }
+    out << "}}";
+    return out.str();
+  }
+
+  void NotifyAll() {
+    std::lock_guard<std::mutex> lock(mu_);
+    cv_.notify_all();
+  }
+
+  void RegisterWaiter(const std::string& pod, bool waiting) {
+    std::lock_guard<std::mutex> lock(mu_);
+    if (waiting) {
+      wait_set_[pod]++;
+    } else {
+      if (--wait_set_[pod] <= 0) wait_set_.erase(pod);
+    }
+    cv_.notify_all();
+  }
+
+ private:
+  PodQuota& Ensure(const std::string& pod) {
+    auto it = pods_.find(pod);
+    if (it == pods_.end()) {
+      // unknown pod (config lag): admit with full limit, no guarantee
+      PodQuota q;
+      q.request = 0.0;
+      q.limit = 1.0;
+      q.mem_cap = 0;
+      q.last_decay = NowMs();
+      it = pods_.emplace(pod, q).first;
+    }
+    return it->second;
+  }
+
+  void DecayLocked(PodQuota& q) {
+    double now = NowMs();
+    if (q.last_decay <= 0) q.last_decay = now;
+    double dt = now - q.last_decay;
+    if (dt > 0) {
+      q.used_ms *= std::exp(-dt / opt_.window);
+      q.last_decay = now;
+    }
+  }
+
+  void DecayAllLocked() {
+    for (auto& kv : pods_) DecayLocked(kv.second);
+  }
+
+  bool Eligible(const std::string& pod) {
+    PodQuota& q = Ensure(pod);
+    return q.used_ms / opt_.window < q.limit;
+  }
+
+  // Is `pod` the best eligible waiter right now?
+  bool IsChosen(const std::string& pod) {
+    std::string best;
+    double best_key = 1e300;
+    for (auto& kv : wait_set_) {
+      PodQuota& q = Ensure(kv.first);
+      double share = q.used_ms / opt_.window;
+      if (share >= q.limit) continue;  // over limit
+      double key;
+      if (q.request > 0 && share < q.request) {
+        // under guarantee: highest deficit first (bucket 0)
+        key = -(q.request - share);
+      } else {
+        // work-conserving (bucket 1, after all guarantee-deficit pods)
+        key = 1.0 + share / q.limit;
+      }
+      if (key < best_key || (key == best_key && kv.first < best)) {
+        best_key = key;
+        best = kv.first;
+      }
+    }
+    return best == pod;
+  }
+
+  double QuotaFor(const PodQuota& q, double est_ms) {
+    size_t active = std::max<size_t>(1, wait_set_.size());
+    double quota = opt_.base_quota / static_cast<double>(active);
+    // cap at the pod's remaining window allowance
+    double allowance = q.limit * opt_.window - q.used_ms;
+    quota = std::min(quota, allowance);
+    if (est_ms > 0) quota = std::max(quota, est_ms);
+    return std::max(quota, opt_.min_quota);
+  }
+
+  const Options& opt_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, PodQuota> pods_;
+  std::map<std::string, int> wait_set_;
+  std::string holder_;
+  double outstanding_quota_ = 0;
+  double grant_time_ = 0;
+  int waiters_ = 0;
+};
+
+// ---------------------------------------------------------------------------
+
+bool ReadLine(int fd, std::string* line) {
+  line->clear();
+  char c;
+  while (true) {
+    ssize_t n = recv(fd, &c, 1, 0);
+    if (n <= 0) return false;
+    if (c == '\n') return true;
+    line->push_back(c);
+  }
+}
+
+bool WriteAll(int fd, const std::string& data) {
+  size_t off = 0;
+  while (off < data.size()) {
+    ssize_t n = send(fd, data.data() + off, data.size() - off, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    off += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+void ServeClient(int fd, TokenScheduler* sched) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::string holder_pod;  // pod name if this connection holds the token
+  std::string line;
+  while (ReadLine(fd, &line)) {
+    std::istringstream in(line);
+    std::string cmd, pod;
+    in >> cmd;
+    if (cmd == "REQ") {
+      double est = 0;
+      in >> pod >> est;
+      if (pod.empty()) break;
+      sched->RegisterWaiter(pod, true);
+      double quota = sched->Acquire(pod, est);
+      sched->RegisterWaiter(pod, false);
+      holder_pod = pod;
+      if (!WriteAll(fd, "TOK " + std::to_string(quota) + "\n")) break;
+    } else if (cmd == "RET") {
+      double used = 0;
+      in >> pod >> used;
+      sched->Release(pod, used);
+      holder_pod.clear();
+      if (!WriteAll(fd, "OK\n")) break;
+    } else if (cmd == "MEM") {
+      long long delta = 0;
+      in >> pod >> delta;
+      auto [ok, used, cap] = sched->Mem(pod, delta);
+      std::string reply = (ok ? "OK " : "DENY ") + std::to_string(used) + " " +
+                          std::to_string(cap) + "\n";
+      if (!WriteAll(fd, reply)) break;
+    } else if (cmd == "STAT") {
+      if (!WriteAll(fd, sched->Stat() + "\n")) break;
+    } else {
+      WriteAll(fd, "ERR unknown command\n");
+    }
+  }
+  if (!holder_pod.empty()) sched->Abandon(holder_pod);
+  close(fd);
+}
+
+void WatchConfig(const Options& opt, TokenScheduler* sched,
+                 std::atomic<bool>* running) {
+  std::string path = opt.config_dir + "/" + opt.config_file;
+  int ino = inotify_init1(IN_NONBLOCK);
+  if (ino >= 0) {
+    inotify_add_watch(ino, opt.config_dir.c_str(),
+                      IN_CLOSE_WRITE | IN_MOVED_TO);
+  }
+  time_t last_mtime = 0;
+  char buf[4096];
+  while (running->load()) {
+    bool reload = false;
+    if (ino >= 0) {
+      struct pollfd pfd = {ino, POLLIN, 0};
+      if (poll(&pfd, 1, 500) > 0) {
+        ssize_t len = read(ino, buf, sizeof(buf));
+        for (ssize_t off = 0; off < len;) {
+          auto* ev = reinterpret_cast<struct inotify_event*>(buf + off);
+          if (ev->len > 0 && opt.config_file == ev->name) reload = true;
+          off += sizeof(struct inotify_event) + ev->len;
+        }
+      }
+    } else {
+      std::this_thread::sleep_for(std::chrono::milliseconds(500));
+    }
+    // mtime fallback (also catches the inotify-less path)
+    struct stat st;
+    if (stat(path.c_str(), &st) == 0 && st.st_mtime != last_mtime) {
+      last_mtime = st.st_mtime;
+      reload = true;
+    }
+    if (reload) {
+      sched->LoadConfig(path);
+    }
+  }
+  if (ino >= 0) close(ino);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Options opt;
+  for (int i = 1; i < argc - 1; i++) {
+    std::string flag = argv[i];
+    if (flag == "-p") opt.config_dir = argv[++i];
+    else if (flag == "-f") opt.config_file = argv[++i];
+    else if (flag == "-P") opt.port = std::atoi(argv[++i]);
+    else if (flag == "-q") opt.base_quota = std::atof(argv[++i]);
+    else if (flag == "-m") opt.min_quota = std::atof(argv[++i]);
+    else if (flag == "-w") opt.window = std::atof(argv[++i]);
+  }
+  if (opt.config_dir.empty() || opt.config_file.empty()) {
+    std::cerr << "usage: tpushare-tokend -p <dir> -f <file> -P <port> "
+                 "[-q base_quota_ms] [-m min_quota_ms] [-w window_ms]\n";
+    return 2;
+  }
+
+  TokenScheduler sched(opt);
+  sched.LoadConfig(opt.config_dir + "/" + opt.config_file);
+
+  std::atomic<bool> running{true};
+  std::thread watcher(WatchConfig, std::cref(opt), &sched, &running);
+
+  int server = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(server, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(opt.port));
+  if (bind(server, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    std::cerr << "tpushare-tokend: bind port " << opt.port << ": "
+              << strerror(errno) << "\n";
+    return 1;
+  }
+  if (listen(server, 64) != 0) {
+    std::cerr << "tpushare-tokend: listen: " << strerror(errno) << "\n";
+    return 1;
+  }
+  std::cerr << "tpushare-tokend: serving on port " << opt.port << " (config "
+            << opt.config_dir << "/" << opt.config_file << ")\n";
+
+  while (true) {
+    int fd = accept(server, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    std::thread(ServeClient, fd, &sched).detach();
+  }
+  running.store(false);
+  watcher.join();
+  return 0;
+}

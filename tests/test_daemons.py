@@ -1,0 +1,224 @@
+"""Collector / aggregator / configd tests, including the full metadata-bus
+integration: collector scrape -> scheduler inventory -> placement ->
+aggregator export -> configd files (SURVEY §3.3's hand-off chain)."""
+
+import os
+import urllib.request
+
+from kubeshare_tpu import constants
+from kubeshare_tpu.aggregator import Aggregator
+from kubeshare_tpu.cell import load_config
+from kubeshare_tpu.cell.allocator import ChipInfo
+from kubeshare_tpu.cluster.api import FakeClock, Node, Pod, PodPhase
+from kubeshare_tpu.cluster.fake import FakeCluster
+from kubeshare_tpu.collector import Collector, FakeEnumerator, PromInventory
+from kubeshare_tpu.configd import ConfigDaemon, write_scheduler_ip
+from kubeshare_tpu.scheduler import KubeShareScheduler, SchedulerEngine
+
+TOPOLOGY = """
+cellTypes:
+  V4-NODE:
+    childCellType: "TPU-v4"
+    childCellNumber: 4
+    childCellPriority: 60
+    isNodeLevel: true
+cells:
+- cellType: V4-NODE
+  cellId: host-a
+"""
+
+CHIPS = [ChipInfo(f"host-a-tpu-{i}", 32 << 30, "TPU-v4", i, (i, 0, 0)) for i in range(4)]
+
+
+def shared_pod(name, request="0.5", limit="1.0"):
+    return Pod(
+        name=name,
+        labels={
+            constants.POD_GPU_LIMIT: limit,
+            constants.POD_GPU_REQUEST: request,
+        },
+        scheduler_name=constants.SCHEDULER_NAME,
+    )
+
+
+class TestCollector:
+    def test_scrape(self):
+        collector = Collector(FakeEnumerator(CHIPS), node_name="host-a")
+        server = collector.serve(port=0)
+        try:
+            url = f"http://127.0.0.1:{server.port}/kubeshare-collector"
+            body = urllib.request.urlopen(url, timeout=5).read().decode()
+            assert body.count("gpu_capacity{") == 4
+            assert 'uuid="host-a-tpu-0"' in body
+            assert 'coords="0,0,0"' in body
+            assert 'memory="34359738368"' in body
+        finally:
+            server.stop()
+
+    def test_prom_inventory_round_trip(self):
+        collector = Collector(FakeEnumerator(CHIPS), node_name="host-a")
+        server = collector.serve(port=0)
+        try:
+            inventory = PromInventory(
+                [f"http://127.0.0.1:{server.port}/kubeshare-collector"], ttl=0
+            )
+            chips = inventory("host-a")
+            assert len(chips) == 4
+            assert chips[0].uuid == "host-a-tpu-0"
+            assert chips[0].memory == 32 << 30
+            assert chips[0].coords == (0, 0, 0)
+            assert inventory("other-node") == []
+        finally:
+            server.stop()
+
+    def test_empty_enumerator(self):
+        collector = Collector(FakeEnumerator([]), node_name="host-a")
+        families = collector.collect()
+        assert families[0].samples == []
+
+
+class TestAggregator:
+    def test_export_and_parse(self):
+        cluster = FakeCluster()
+        pod = shared_pod("mnist1")
+        pod.node_name = "host-a"
+        pod.phase = PodPhase.RUNNING
+        pod.annotations[constants.POD_GPU_UUID] = "host-a-tpu-0"
+        pod.annotations[constants.POD_CELL_ID] = "host-a/1"
+        pod.annotations[constants.POD_GPU_MEMORY] = "1024"
+        pod.annotations[constants.POD_MANAGER_PORT] = "50051"
+        cluster.create_pod(pod)
+        # pending + regular pods are not exported
+        cluster.create_pod(shared_pod("pending"))
+        cluster.create_pod(Pod(name="reg", scheduler_name=constants.SCHEDULER_NAME))
+
+        aggregator = Aggregator(cluster)
+        reqs = aggregator.get_pods()
+        assert len(reqs) == 1
+        r = reqs[0]
+        assert r.uuid == "host-a-tpu-0" and r.port == "50051"
+        assert r.group_name == "default/mnist1"  # defaults to pod key
+        families = aggregator.collect()
+        sample = families[0].samples[0]
+        assert sample.labels["cell_id"] == "host-a/1"
+        assert sample.labels["memory"] == "1024"
+
+
+class TestConfigDaemon:
+    def _bound_pod(self, cluster, name, uuid, port, request="0.5", limit="1.0",
+                   memory="1024", node="host-a"):
+        pod = shared_pod(name, request=request, limit=limit)
+        pod.node_name = node
+        pod.phase = PodPhase.RUNNING
+        pod.annotations[constants.POD_GPU_UUID] = uuid
+        pod.annotations[constants.POD_GPU_MEMORY] = memory
+        pod.annotations[constants.POD_MANAGER_PORT] = port
+        cluster.create_pod(pod)
+        return pod
+
+    def test_writes_config_files(self, tmp_path):
+        cluster = FakeCluster()
+        daemon = ConfigDaemon(
+            "host-a",
+            cluster=cluster,
+            config_dir=str(tmp_path / "config"),
+            port_dir=str(tmp_path / "ports"),
+        )
+        self._bound_pod(cluster, "p1", "host-a-tpu-0", "50051")
+        self._bound_pod(cluster, "p2", "host-a-tpu-0", "50052", request="0.3")
+        config = open(tmp_path / "config" / "host-a-tpu-0").read()
+        lines = config.splitlines()
+        assert lines[0] == "2"
+        assert "default/p1 1.0 0.5 1024" in lines
+        assert "default/p2 1.0 0.3 1024" in lines
+        ports = open(tmp_path / "ports" / "host-a-tpu-0").read().splitlines()
+        assert ports[0] == "2" and "default/p2 50052" in ports
+
+    def test_reset_on_empty(self, tmp_path):
+        cluster = FakeCluster()
+        daemon = ConfigDaemon(
+            "host-a",
+            cluster=cluster,
+            config_dir=str(tmp_path / "config"),
+            port_dir=str(tmp_path / "ports"),
+        )
+        self._bound_pod(cluster, "p1", "host-a-tpu-0", "50051")
+        cluster.delete_pod("default", "p1")
+        daemon.sync()
+        assert open(tmp_path / "config" / "host-a-tpu-0").read() == "0\n"
+        assert open(tmp_path / "ports" / "host-a-tpu-0").read() == "0\n"
+
+    def test_other_node_ignored(self, tmp_path):
+        cluster = FakeCluster()
+        daemon = ConfigDaemon(
+            "host-a",
+            cluster=cluster,
+            config_dir=str(tmp_path / "config"),
+            port_dir=str(tmp_path / "ports"),
+        )
+        self._bound_pod(cluster, "px", "host-b-tpu-0", "50051", node="host-b")
+        assert os.listdir(tmp_path / "config") == []
+
+    def test_aggregator_mode(self, tmp_path):
+        cluster = FakeCluster()
+        self._bound_pod(cluster, "p1", "host-a-tpu-0", "50051")
+        aggregator = Aggregator(cluster)
+        server = aggregator.serve(port=0)
+        try:
+            daemon = ConfigDaemon(
+                "host-a",
+                aggregator_url=f"http://127.0.0.1:{server.port}/kubeshare-aggregator",
+                config_dir=str(tmp_path / "config"),
+                port_dir=str(tmp_path / "ports"),
+            )
+            daemon.sync()
+            config = open(tmp_path / "config" / "host-a-tpu-0").read()
+            assert config.startswith("1\n")
+            assert "default/p1 1.0 0.5" in config
+        finally:
+            server.stop()
+
+    def test_write_scheduler_ip(self, tmp_path):
+        path = write_scheduler_ip("10.0.0.7", str(tmp_path))
+        assert open(path).read() == "10.0.0.7\n"
+
+
+class TestMetadataBusIntegration:
+    def test_collector_to_configd_chain(self, tmp_path):
+        """SURVEY §3.3: scrape -> schedule -> export -> config files."""
+        # collector on host-a
+        collector = Collector(FakeEnumerator(CHIPS), node_name="host-a")
+        server = collector.serve(port=0)
+        try:
+            cluster = FakeCluster()
+            cluster.add_node(Node("host-a", {constants.NODE_LABEL_FILTER: "true"}))
+            clock = FakeClock(0)
+            inventory = PromInventory(
+                [f"http://127.0.0.1:{server.port}/kubeshare-collector"], ttl=0
+            )
+            plugin = KubeShareScheduler(
+                load_config(text=TOPOLOGY), cluster, inventory, clock=clock
+            )
+            engine = SchedulerEngine(plugin, cluster, clock)
+            daemon = ConfigDaemon(
+                "host-a",
+                cluster=cluster,
+                config_dir=str(tmp_path / "config"),
+                port_dir=str(tmp_path / "ports"),
+            )
+            # two 0.5 pods -> same chip (BASELINE config 2)
+            cluster.create_pod(shared_pod("mnist1"))
+            cluster.create_pod(shared_pod("mnist2"))
+            engine.run_until_idle()
+            for name in ("mnist1", "mnist2"):
+                cluster.set_pod_phase("default", name, PodPhase.RUNNING)
+            uuid = cluster.get_pod("default", "mnist1").annotations[
+                constants.POD_GPU_UUID
+            ]
+            config = open(tmp_path / "config" / uuid).read()
+            assert config.startswith("2\n")
+            assert "default/mnist1 1.0 0.5" in config
+            ports = open(tmp_path / "ports" / uuid).read()
+            assert ports.startswith("2\n")
+        finally:
+            server.stop()
