@@ -15,6 +15,7 @@ The TPU-native enforcement points (SURVEY §7.2):
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Any, Callable, Optional, TypeVar
 
@@ -55,7 +56,7 @@ class ExecutionGuard:
     """
 
     def __init__(self, client: Optional[TokenClient] = None,
-                 from_env: bool = True) -> None:
+                 from_env: bool = True, idle_release_ms: float = 200.0) -> None:
         self.log = get_logger("tpushim")
         if client is None and from_env:
             try:
@@ -65,6 +66,13 @@ class ExecutionGuard:
                 client = None
         self.client = client
         self._estimate_ms = 1.0  # EMA of step wall time
+        self._budget_ms = 0.0  # remaining quota on the held token
+        self._held_used_ms = 0.0  # device time consumed on the held token
+        self._held = False
+        self._lock = threading.RLock()
+        self._last_activity = 0.0
+        self._idle_release_ms = idle_release_ms
+        self._monitor: Optional[threading.Thread] = None
         self.tokens_acquired = 0
         self.total_gated_ms = 0.0
 
@@ -84,25 +92,88 @@ class ExecutionGuard:
                 result = _block_until_ready(result)
             finally:
                 elapsed_ms = (time.monotonic() - start) * 1e3
-                self.release(elapsed_ms)
+                self.charge(elapsed_ms)
             return result
 
         gated.__name__ = getattr(fn, "__name__", "gated")
         return gated  # type: ignore[return-value]
 
     def acquire(self) -> float:
+        """Ensure a token with remaining budget is held.
+
+        Tokens are *budgeted*: one grant covers many steps until its quota
+        (ms of device time) is consumed — the Gemini token model (quota
+        20-300ms per grant), without a broker round trip per step.  A
+        monitor thread returns a held token after ``idle_release_ms`` of
+        inactivity so an idle workload never starves co-tenants (relevant
+        under the exclusive tokend mode).
+        """
         if self.client is None:
             return 0.0
-        quota = self.client.acquire(self._estimate_ms)
-        self.tokens_acquired += 1
-        return quota
+        with self._lock:
+            self._last_activity = time.monotonic()
+            if self._held and self._budget_ms > 0:
+                return self._budget_ms
+            if self._held:
+                self._release_held()
+            quota = self.client.acquire(self._estimate_ms)
+            self.tokens_acquired += 1
+            self._held = True
+            self._budget_ms = quota
+            self._held_used_ms = 0.0
+            self._ensure_monitor()
+            return quota
 
-    def release(self, elapsed_ms: float) -> None:
+    def charge(self, elapsed_ms: float) -> None:
+        """Consume budget for one step; release the token when exhausted."""
         if self.client is None:
             return
-        self._estimate_ms = 0.8 * self._estimate_ms + 0.2 * elapsed_ms
-        self.total_gated_ms += elapsed_ms
-        self.client.release(elapsed_ms)
+        with self._lock:
+            self._last_activity = time.monotonic()
+            self._estimate_ms = 0.8 * self._estimate_ms + 0.2 * elapsed_ms
+            self.total_gated_ms += elapsed_ms
+            self._budget_ms -= elapsed_ms
+            self._held_used_ms += elapsed_ms
+            if self._held and self._budget_ms <= 0:
+                self._release_held()
+
+    # backwards-compatible single-step release
+    def release(self, elapsed_ms: float) -> None:
+        self.charge(elapsed_ms)
+
+    def finish(self) -> None:
+        """Return any held token (call when the workload goes idle)."""
+        with self._lock:
+            if self._held:
+                self._release_held()
+
+    def _release_held(self) -> None:
+        assert self.client is not None
+        self.client.release(self._held_used_ms)
+        self._held = False
+        self._budget_ms = 0.0
+        self._held_used_ms = 0.0
+
+    def _ensure_monitor(self) -> None:
+        if self._monitor is not None or self._idle_release_ms <= 0:
+            return
+
+        def watch() -> None:
+            while True:
+                time.sleep(self._idle_release_ms / 1e3 / 4)
+                with self._lock:
+                    idle_ms = (time.monotonic() - self._last_activity) * 1e3
+                    if self._held and idle_ms >= self._idle_release_ms:
+                        try:
+                            self._release_held()
+                        except ConnectionError:
+                            # broker gone (teardown/restart); it reclaims the
+                            # token via its own drop handling
+                            self._held = False
+                            self._budget_ms = 0.0
+
+        self._monitor = threading.Thread(target=watch, daemon=True)
+        self._monitor.start()
 
     def request_memory(self, delta_bytes: int) -> bool:
         if self.client is None:

@@ -1,0 +1,15 @@
+from .mnist import MnistConfig, mnist_init, mnist_apply
+from .resnet import ResNetConfig, resnet_init, resnet_apply
+from .transformer import TransformerConfig, transformer_init, transformer_apply
+
+__all__ = [
+    "MnistConfig",
+    "mnist_init",
+    "mnist_apply",
+    "ResNetConfig",
+    "resnet_init",
+    "resnet_apply",
+    "TransformerConfig",
+    "transformer_init",
+    "transformer_apply",
+]

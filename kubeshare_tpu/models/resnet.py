@@ -1,0 +1,101 @@
+"""CIFAR ResNet — the gang-job workload (BASELINE config 3: cifar10 Job,
+parallelism 5, group coscheduling; ref test/cifar10/job.yaml).
+
+ResNet-18-style basic blocks, NHWC, GroupNorm instead of BatchNorm (no
+cross-replica batch statistics needed — the dp all-reduce stays in the
+gradient path where XLA handles it).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 10
+    widths: Tuple[int, ...] = (64, 128, 256, 512)
+    blocks_per_stage: Tuple[int, ...] = (2, 2, 2, 2)
+    groups: int = 8
+
+
+def _conv_init(key, shape):
+    fan_in = shape[0] * shape[1] * shape[2]
+    return jax.random.normal(key, shape, jnp.float32) * (2.0 / fan_in) ** 0.5
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _group_norm(x, scale, bias, groups):
+    b, h, w, c = x.shape
+    x = x.reshape(b, h, w, groups, c // groups)
+    mean = x.mean(axis=(1, 2, 4), keepdims=True)
+    var = x.var(axis=(1, 2, 4), keepdims=True)
+    x = (x - mean) * jax.lax.rsqrt(var + 1e-5)
+    return x.reshape(b, h, w, c) * scale + bias
+
+
+def resnet_init(rng: jax.Array, config: ResNetConfig = ResNetConfig()) -> Dict:
+    keys = iter(jax.random.split(rng, 64))
+    params: Dict = {
+        "stem": {"w": _conv_init(next(keys), (3, 3, 3, config.widths[0])),
+                 "scale": jnp.ones((config.widths[0],)),
+                 "bias": jnp.zeros((config.widths[0],))},
+        "stages": [],
+    }
+    in_ch = config.widths[0]
+    for width, n_blocks in zip(config.widths, config.blocks_per_stage):
+        stage: List[Dict] = []
+        for block_idx in range(n_blocks):
+            stride = 2 if (block_idx == 0 and width != in_ch) else 1
+            block = {
+                "conv1": {"w": _conv_init(next(keys), (3, 3, in_ch, width)),
+                          "scale": jnp.ones((width,)), "bias": jnp.zeros((width,))},
+                "conv2": {"w": _conv_init(next(keys), (3, 3, width, width)),
+                          "scale": jnp.ones((width,)), "bias": jnp.zeros((width,))},
+            }
+            if stride != 1 or in_ch != width:
+                block["proj"] = {"w": _conv_init(next(keys), (1, 1, in_ch, width))}
+            stage.append(block)
+            in_ch = width
+        params["stages"].append(stage)
+    params["head"] = {
+        "w": jax.random.normal(next(keys), (in_ch, config.num_classes), jnp.float32)
+        * (1.0 / in_ch) ** 0.5,
+        "b": jnp.zeros((config.num_classes,)),
+    }
+    return params
+
+
+def resnet_apply(params: Dict, images: jax.Array,
+                 config: ResNetConfig = ResNetConfig()) -> jax.Array:
+    """images: [batch, 32, 32, 3] -> logits."""
+    x = _conv(images, params["stem"]["w"])
+    x = _group_norm(x, params["stem"]["scale"], params["stem"]["bias"], config.groups)
+    x = jax.nn.relu(x)
+    for stage in params["stages"]:
+        for block in stage:
+            # a projection exists exactly when the block downsamples
+            stride = 2 if "proj" in block else 1
+            residual = x
+            y = _conv(x, block["conv1"]["w"], stride)
+            y = _group_norm(y, block["conv1"]["scale"], block["conv1"]["bias"],
+                            config.groups)
+            y = jax.nn.relu(y)
+            y = _conv(y, block["conv2"]["w"])
+            y = _group_norm(y, block["conv2"]["scale"], block["conv2"]["bias"],
+                            config.groups)
+            if "proj" in block:
+                residual = _conv(residual, block["proj"]["w"], stride)
+            x = jax.nn.relu(residual + y)
+    x = x.mean(axis=(1, 2))
+    return x @ params["head"]["w"] + params["head"]["b"]

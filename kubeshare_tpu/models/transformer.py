@@ -1,0 +1,188 @@
+"""Flagship model: decoder-only Transformer LM, designed mesh-first.
+
+This is the model ``__graft_entry__`` exposes and the multi-chip dry run
+shards.  Every weight has a named-sharding rule over the (dp, tp, sp) mesh
+(``transformer_sharding_rules``): attention heads and MLP hidden split over
+tp, embeddings split over tp's feature axis, activations batch-split over dp
+and sequence-split over sp (ring attention).  bf16 activations by default —
+MXU-friendly — with f32 parameters/optimizer.
+
+The reference framework contains no model code (SURVEY §2.10); this is the
+distributed-workload half the prompt makes first-class.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import attention_reference, flash_attention
+from ..ops.ring_attention import ring_attention
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    dtype: jnp.dtype = jnp.bfloat16
+    attention: str = "auto"  # auto | reference | flash | ring
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def transformer_init(rng: jax.Array, config: TransformerConfig) -> Dict:
+    n = 4 + 6 * config.n_layers
+    keys = iter(jax.random.split(rng, n))
+    d, h, f = config.d_model, config.n_heads, config.d_ff
+    hd = config.head_dim
+
+    def dense(key, shape, fan_in):
+        return jax.random.normal(key, shape, jnp.float32) * (1.0 / fan_in) ** 0.5
+
+    params: Dict = {
+        "embed": dense(next(keys), (config.vocab_size, d), d),
+        "pos_embed": dense(next(keys), (config.max_seq_len, d), d),
+        "layers": [],
+        "final_norm": {"scale": jnp.ones((d,))},
+        "lm_head": dense(next(keys), (d, config.vocab_size), d),
+    }
+    for _ in range(config.n_layers):
+        params["layers"].append(
+            {
+                "attn": {
+                    "wq": dense(next(keys), (d, h, hd), d),
+                    "wk": dense(next(keys), (d, h, hd), d),
+                    "wv": dense(next(keys), (d, h, hd), d),
+                    "wo": dense(next(keys), (h, hd, d), d),
+                },
+                "mlp": {
+                    "w_in": dense(next(keys), (d, f), d),
+                    "w_out": dense(next(keys), (f, d), f),
+                },
+                "norm1": {"scale": jnp.ones((d,))},
+                "norm2": {"scale": jnp.ones((d,))},
+            }
+        )
+    return params
+
+
+def _rms_norm(x, scale):
+    norm = jax.lax.rsqrt(jnp.mean(x.astype(jnp.float32) ** 2, -1, keepdims=True) + 1e-6)
+    return (x * norm.astype(x.dtype)) * scale.astype(x.dtype)
+
+
+def _select_attention(config: TransformerConfig):
+    kind = config.attention
+    if kind == "auto":
+        kind = "flash" if jax.devices()[0].platform == "tpu" else "reference"
+    if kind == "flash":
+        return lambda q, k, v: flash_attention(q, k, v, causal=True)
+    return lambda q, k, v: attention_reference(q, k, v, causal=True)
+
+
+def _forward(params, tokens, config, attention_fn, pos_offset):
+    """Shared forward body; pos_offset supports sequence-sharded callers."""
+    dtype = config.dtype
+    seq = tokens.shape[1]
+    x = params["embed"][tokens].astype(dtype)
+    pos = jax.lax.dynamic_slice_in_dim(params["pos_embed"], pos_offset, seq)
+    x = x + pos.astype(dtype)
+
+    for layer in params["layers"]:
+        # attention block
+        y = _rms_norm(x, layer["norm1"]["scale"])
+        q = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wq"].astype(dtype))
+        k = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wk"].astype(dtype))
+        v = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wv"].astype(dtype))
+        o = attention_fn(q, k, v).astype(dtype)
+        x = x + jnp.einsum("bhsk,hkd->bsd", o, layer["attn"]["wo"].astype(dtype))
+        # mlp block
+        y = _rms_norm(x, layer["norm2"]["scale"])
+        y = jax.nn.gelu(y @ layer["mlp"]["w_in"].astype(dtype))
+        x = x + y @ layer["mlp"]["w_out"].astype(dtype)
+
+    x = _rms_norm(x, params["final_norm"]["scale"])
+    return (x @ params["lm_head"].astype(dtype)).astype(jnp.float32)
+
+
+def transformer_apply(
+    params: Dict,
+    tokens: jax.Array,
+    config: TransformerConfig,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """tokens: [batch, seq] int32 -> logits [batch, seq, vocab].
+
+    ``attention="ring"`` needs a sequence-sharded caller — use
+    ``transformer_apply_ring`` (this entry point has no mesh axis bound).
+    """
+    if config.attention == "ring":
+        raise ValueError(
+            "attention='ring' shards the sequence axis; call "
+            "transformer_apply_ring(params, tokens, config, mesh) instead"
+        )
+    return _forward(params, tokens, config, _select_attention(config), 0)
+
+
+def transformer_apply_ring(
+    params: Dict,
+    tokens: jax.Array,
+    config: TransformerConfig,
+    mesh: Mesh,
+    batch_axis: Optional[str] = "dp",
+    seq_axis: str = "sp",
+) -> jax.Array:
+    """Sequence-parallel forward: tokens sharded over ``seq_axis``, ring
+    attention carrying K/V around the ICI ring (long-context path)."""
+
+    def local_forward(params, tokens):
+        local_seq = tokens.shape[1]
+        offset = jax.lax.axis_index(seq_axis) * local_seq
+        attention_fn = lambda q, k, v: ring_attention(
+            q, k, v, axis_name=seq_axis, causal=True
+        )
+        return _forward(params, tokens, config, attention_fn, offset)
+
+    return jax.shard_map(
+        local_forward,
+        mesh=mesh,
+        in_specs=(P(), P(batch_axis, seq_axis)),
+        out_specs=P(batch_axis, seq_axis, None),
+    )(params, tokens)
+
+
+def transformer_sharding_rules() -> Dict[str, P]:
+    """Path-substring -> PartitionSpec rules over the (dp, tp, sp) mesh.
+
+    tp splits attention heads and MLP hidden; embeddings/lm_head split on the
+    vocab axis; norms replicate.  Used with parallel.mesh.shard_params /
+    param_spec_tree.
+    """
+    return {
+        "embed": P("tp", None),
+        "pos_embed": P(),
+        "wq": P(None, "tp", None),
+        "wk": P(None, "tp", None),
+        "wv": P(None, "tp", None),
+        "wo": P("tp", None, None),
+        "w_in": P(None, "tp"),
+        "w_out": P("tp", None),
+        "lm_head": P(None, "tp"),
+        "norm": P(),
+        "scale": P(),
+    }
+
+
+def transformer_activation_spec(use_sp: bool = True) -> P:
+    """Sharding for the [batch, seq] token array."""
+    return P("dp", "sp") if use_sp else P("dp", None)

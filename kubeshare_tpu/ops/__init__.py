@@ -1,0 +1,4 @@
+from .attention import attention_reference, flash_attention
+from .ring_attention import ring_attention
+
+__all__ = ["attention_reference", "flash_attention", "ring_attention"]

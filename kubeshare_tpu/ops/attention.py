@@ -1,0 +1,132 @@
+"""Attention ops: XLA reference + Pallas TPU kernel.
+
+The compute path is designed MXU-first (SURVEY-prompt constraints): large
+batched matmuls, bf16-friendly, static shapes.  ``flash_attention`` runs a
+Pallas kernel that streams query blocks through VMEM (never materializing
+the full S x S score matrix in HBM); gradients recompute through the XLA
+reference implementation via custom_vjp — XLA fuses that path well, and the
+kernel keeps the forward/serving path HBM-lean.
+
+Shapes: q, k, v are [batch, heads, seq, head_dim].
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def attention_reference(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True
+) -> jax.Array:
+    """Plain XLA attention; the correctness oracle and autodiff path."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+def _attention_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool, block_q: int):
+    # q block: [block_q, d]; full k/v for this (batch, head): [s, d]
+    import jax.experimental.pallas as pl  # local import: TPU-only dependency
+
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    if causal:
+        block_idx = pl.program_id(2)
+        q_pos = block_idx * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 0
+        )
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        scores = jnp.where(q_pos >= k_pos, scores, jnp.finfo(jnp.float32).min)
+    scores -= jnp.max(scores, axis=-1, keepdims=True)
+    probs = jnp.exp(scores)
+    probs /= jnp.sum(probs, axis=-1, keepdims=True)
+    o_ref[0, 0] = jnp.dot(probs, v, preferred_element_type=jnp.float32).astype(
+        o_ref.dtype
+    )
+
+
+def _flash_forward(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool,
+    block_q: int,
+    interpret: bool,
+) -> jax.Array:
+    import jax.experimental.pallas as pl
+
+    b, h, s, d = q.shape
+    block_q = min(block_q, s)
+    if s % block_q != 0:
+        # static shapes only under jit: fall back rather than pad dynamically
+        return attention_reference(q, k, v, causal)
+    grid = (b, h, s // block_q)
+    kernel = functools.partial(_attention_kernel, causal=causal, block_q=block_q)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)
+        ),
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_attention(q, k, v, causal, block_q, interpret):
+    return _flash_forward(q, k, v, causal, block_q, interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, interpret):
+    out = _flash_forward(q, k, v, causal, block_q, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, block_q, interpret, residuals, g):
+    q, k, v = residuals
+    # rematerialized backward through the XLA reference path
+    _, vjp = jax.vjp(lambda q, k, v: attention_reference(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+_flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = 128,
+    use_pallas: Optional[bool] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Attention with the Pallas TPU kernel when available.
+
+    ``use_pallas=None`` auto-selects: kernel on TPU backends, XLA reference
+    elsewhere (CPU tests can force the kernel with ``interpret=True``).
+    """
+    if use_pallas is None:
+        platform = jax.devices()[0].platform
+        use_pallas = platform == "tpu" or interpret
+    if not use_pallas:
+        return attention_reference(q, k, v, causal)
+    return _flash_attention(q, k, v, causal, block_q, interpret)

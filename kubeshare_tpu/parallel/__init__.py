@@ -1,0 +1,11 @@
+from .mesh import MeshSpec, make_mesh, batch_sharding, replicated
+from .train import TrainState, make_train_step
+
+__all__ = [
+    "MeshSpec",
+    "make_mesh",
+    "batch_sharding",
+    "replicated",
+    "TrainState",
+    "make_train_step",
+]

@@ -1,0 +1,112 @@
+"""Device-mesh construction and sharding helpers.
+
+The workload side of the framework is TPU-first: scale comes from
+``jax.sharding.Mesh`` + named shardings compiled by XLA into ICI
+collectives, not from an MPI/NCCL-style communicator (SURVEY §2.10 — the
+reference schedules NCCL DDP workloads; here the equivalent workloads are
+pjit programs over these meshes).
+
+Axis vocabulary used across models/ops:
+  dp  data parallel (batch split; gradients all-reduced by XLA)
+  fsdp parameter sharding along dp (zero-style), optional
+  tp  tensor parallel (head/feature split inside layers)
+  sp  sequence parallel (ring attention shards the sequence axis)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape; -1 on one axis absorbs remaining devices."""
+
+    dp: int = -1
+    tp: int = 1
+    sp: int = 1
+
+    def resolve(self, n_devices: int) -> Tuple[int, int, int]:
+        known = [d for d in (self.dp, self.tp, self.sp) if d != -1]
+        prod = int(np.prod(known)) if known else 1
+        if -1 in (self.dp, self.tp, self.sp):
+            if n_devices % prod != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {prod}"
+                )
+            fill = n_devices // prod
+        else:
+            fill = None
+            if prod != n_devices:
+                raise ValueError(
+                    f"mesh {self})={prod} devices != available {n_devices}"
+                )
+        dims = tuple(
+            (fill if d == -1 else d) for d in (self.dp, self.tp, self.sp)
+        )
+        return dims  # type: ignore[return-value]
+
+
+def make_mesh(
+    spec: MeshSpec = MeshSpec(),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    dp, tp, sp = spec.resolve(len(devices))
+    array = np.array(devices).reshape(dp, tp, sp)
+    return Mesh(array, ("dp", "tp", "sp"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 2, seq_axis: Optional[int] = None) -> NamedSharding:
+    """Shard axis 0 over dp; optionally a sequence axis over sp."""
+    spec = [None] * ndim
+    spec[0] = "dp"
+    if seq_axis is not None and mesh.shape.get("sp", 1) > 1:
+        spec[seq_axis] = "sp"
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_params(params, rules: Dict[str, P], mesh: Mesh):
+    """Place a param pytree by path-matching rules; unmatched -> replicated.
+
+    Rules map a substring of the flattened path (e.g. "attn/wq") to a
+    PartitionSpec.  First match wins, most-specific (longest) first.
+    """
+    ordered = sorted(rules.items(), key=lambda kv: -len(kv[0]))
+
+    def place(path: str, x):
+        for needle, spec in ordered:
+            if needle in path:
+                return jax.device_put(x, NamedSharding(mesh, spec))
+        return jax.device_put(x, replicated(mesh))
+
+    flat = jax.tree_util.tree_flatten_with_path(params)
+    placed = [
+        place(jax.tree_util.keystr(path), leaf) for path, leaf in flat[0]
+    ]
+    return jax.tree_util.tree_unflatten(flat[1], placed)
+
+
+def param_spec_tree(params, rules: Dict[str, P]):
+    """Like shard_params but returns the PartitionSpec tree (for pjit
+    in_shardings)."""
+    ordered = sorted(rules.items(), key=lambda kv: -len(kv[0]))
+
+    def spec_for(path: str):
+        for needle, spec in ordered:
+            if needle in path:
+                return spec
+        return P()
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    specs = [spec_for(jax.tree_util.keystr(path)) for path, _ in flat]
+    return jax.tree_util.tree_unflatten(treedef, specs)

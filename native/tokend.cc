@@ -26,11 +26,20 @@
 //   MEM <pod> <delta>    -> OK <used> <cap> | DENY <used> <cap>
 //   STAT                 -> one JSON line
 //
-// Scheduling policy: exclusive token (one pod drives the chip at a time).
-// Pick among eligible waiters: pods under their guaranteed share
-// (used/window < request) first, by largest deficit; then work-conserving
-// by smallest used/limit.  Over-limit pods wait for decay.  Quota shrinks
-// from base toward min as the number of active pods grows.
+// Scheduling policy, two modes:
+//
+// * concurrent (default, TPU-native): a token is the right to dispatch;
+//   multiple pods may hold tokens at once (the chip's hardware queue
+//   serializes executions, and XLA programs cannot be preempted anyway).
+//   Enforcement is by decayed device-time share: a pod at/over its `limit`
+//   share blocks until decay; when any *starved* pod (share < request) is
+//   waiting, non-starved pods yield — request is a guaranteed floor, limit
+//   a hard cap, idle gaps are work-conserving.
+//
+// * exclusive (-x, Gemini-parity): one pod drives the chip at a time;
+//   among eligible waiters, pods under their guaranteed share first (by
+//   largest deficit), then work-conserving by smallest used/limit.  Quota
+//   shrinks from base toward min as the number of active pods grows.
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -73,6 +82,8 @@ struct PodQuota {
   // accounting
   double used_ms = 0.0;     // decayed usage within the window
   double last_decay = 0.0;  // ms timestamp of last decay application
+  double grant_time = 0.0;  // ms timestamp of last token grant
+  double outstanding_quota = 0.0;
   long long mem_used = 0;
   long long grants = 0;
   bool in_config = true;
@@ -85,6 +96,7 @@ struct Options {
   double base_quota = 300.0;
   double min_quota = 20.0;
   double window = 10000.0;
+  bool exclusive = false;
 };
 
 class TokenScheduler {
@@ -112,9 +124,9 @@ class TokenScheduler {
       }
       if (q.limit <= 0.0) q.limit = 1.0;
     }
-    // drop pods no longer configured and not holding the token
+    // drop pods no longer configured and not holding a token
     for (auto it = pods_.begin(); it != pods_.end();) {
-      if (!it->second.in_config && holder_ != it->first) {
+      if (!it->second.in_config && holders_.count(it->first) == 0) {
         it = pods_.erase(it);
       } else {
         ++it;
@@ -123,7 +135,7 @@ class TokenScheduler {
     cv_.notify_all();
   }
 
-  // Blocks until this pod is granted the token; returns quota in ms.
+  // Blocks until this pod is granted a token; returns quota in ms.
   double Acquire(const std::string& pod, double est_ms) {
     std::unique_lock<std::mutex> lock(mu_);
     waiters_++;
@@ -131,43 +143,46 @@ class TokenScheduler {
     // passing (usage decay), which nothing notifies about
     while (true) {
       DecayAllLocked();
-      if (holder_.empty() && Eligible(pod) && IsChosen(pod)) break;
+      if (opt_.exclusive) {
+        if (holders_.empty() && Eligible(pod) && IsChosen(pod)) break;
+      } else {
+        if (Eligible(pod) && (Starved(pod) || !StarvedWaiterExists(pod))) break;
+      }
       cv_.wait_for(lock, std::chrono::milliseconds(20));
     }
     waiters_--;
-    holder_ = pod;
     PodQuota& q = Ensure(pod);
     q.grants++;
     double quota = QuotaFor(q, est_ms);
-    outstanding_quota_ = quota;
-    grant_time_ = NowMs();
+    holders_[pod]++;
+    q.grant_time = NowMs();
+    q.outstanding_quota = quota;
     return quota;
   }
 
   void Release(const std::string& pod, double used_ms) {
     std::lock_guard<std::mutex> lock(mu_);
-    if (holder_ != pod) return;
+    auto it = holders_.find(pod);
+    if (it == holders_.end()) return;
     PodQuota& q = Ensure(pod);
     DecayLocked(q);
     // trust the measured device time but charge at least a fraction of the
     // grant — a client that always reports 0 would otherwise stay
     // perpetually under its request and monopolize the chip
-    double hold_ms = NowMs() - grant_time_;
-    double floor_ms = std::min(0.05 * outstanding_quota_, hold_ms);
-    double charge = std::max(used_ms, floor_ms);
-    q.used_ms += charge;
-    holder_.clear();
-    outstanding_quota_ = 0;
+    double hold_ms = NowMs() - q.grant_time;
+    double floor_ms = std::min(0.05 * q.outstanding_quota, hold_ms);
+    q.used_ms += std::max(used_ms, floor_ms);
+    if (--it->second <= 0) holders_.erase(it);
     cv_.notify_all();
   }
 
-  // Connection died while holding the token: charge full quota.
+  // Connection died while holding a token: charge the full quota.
   void Abandon(const std::string& pod) {
     std::lock_guard<std::mutex> lock(mu_);
-    if (holder_ != pod) return;
-    Ensure(pod).used_ms += outstanding_quota_;
-    holder_.clear();
-    outstanding_quota_ = 0;
+    auto it = holders_.find(pod);
+    if (it == holders_.end()) return;
+    Ensure(pod).used_ms += Ensure(pod).outstanding_quota;
+    if (--it->second <= 0) holders_.erase(it);
     cv_.notify_all();
   }
 
@@ -189,7 +204,8 @@ class TokenScheduler {
     std::lock_guard<std::mutex> lock(mu_);
     DecayAllLocked();
     std::ostringstream out;
-    out << "{\"holder\":\"" << holder_ << "\",\"waiters\":" << waiters_
+    out << "{\"mode\":\"" << (opt_.exclusive ? "exclusive" : "concurrent")
+        << "\",\"holders\":" << holders_.size() << ",\"waiters\":" << waiters_
         << ",\"pods\":{";
     bool first = true;
     for (auto& kv : pods_) {
@@ -256,6 +272,19 @@ class TokenScheduler {
     return q.used_ms / opt_.window < q.limit;
   }
 
+  bool Starved(const std::string& pod) {
+    PodQuota& q = Ensure(pod);
+    return q.request > 0 && q.used_ms / opt_.window < q.request;
+  }
+
+  // another waiting pod is below its guaranteed share
+  bool StarvedWaiterExists(const std::string& self) {
+    for (auto& kv : wait_set_) {
+      if (kv.first != self && kv.second > 0 && Starved(kv.first)) return true;
+    }
+    return false;
+  }
+
   // Is `pod` the best eligible waiter right now?
   bool IsChosen(const std::string& pod) {
     std::string best;
@@ -295,9 +324,7 @@ class TokenScheduler {
   std::condition_variable cv_;
   std::map<std::string, PodQuota> pods_;
   std::map<std::string, int> wait_set_;
-  std::string holder_;
-  double outstanding_quota_ = 0;
-  double grant_time_ = 0;
+  std::map<std::string, int> holders_;  // pod -> outstanding token count
   int waiters_ = 0;
 };
 
@@ -415,6 +442,9 @@ int main(int argc, char** argv) {
     else if (flag == "-q") opt.base_quota = std::atof(argv[++i]);
     else if (flag == "-m") opt.min_quota = std::atof(argv[++i]);
     else if (flag == "-w") opt.window = std::atof(argv[++i]);
+  }
+  for (int i = 1; i < argc; i++) {
+    if (std::string(argv[i]) == "-x") opt.exclusive = true;
   }
   if (opt.config_dir.empty() || opt.config_file.empty()) {
     std::cerr << "usage: tpushare-tokend -p <dir> -f <file> -P <port> "

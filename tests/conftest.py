@@ -14,3 +14,9 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# the axon TPU plugin ignores the JAX_PLATFORMS env var; the config knob is
+# honored, so force CPU here too (before any backend initializes)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -41,24 +41,38 @@ def wait_listening(port, timeout=10.0):
     raise TimeoutError(f"nothing listening on {port}")
 
 
-@pytest.fixture
-def tokend(tmp_path):
-    """A running tokend with two pods sharing one chip (0.5/0.3)."""
+def _start_tokend(tmp_path, exclusive=False, config=None):
     config_dir = tmp_path / "config"
-    config_dir.mkdir()
+    config_dir.mkdir(exist_ok=True)
     uuid = "chip-0"
     write_atomic(
         str(config_dir / uuid),
-        "2\nns/pod-a 1.0 0.5 1000000\nns/pod-b 1.0 0.3 500000\n",
+        config or "2\nns/pod-a 1.0 0.5 1000000\nns/pod-b 1.0 0.3 500000\n",
     )
     port = free_port()
-    proc = subprocess.Popen(
-        [TOKEND, "-p", str(config_dir), "-f", uuid, "-P", str(port),
-         "-q", "50", "-m", "5", "-w", "1000"],
-        stderr=subprocess.DEVNULL,
-    )
+    cmd = [TOKEND, "-p", str(config_dir), "-f", uuid, "-P", str(port),
+           "-q", "50", "-m", "5", "-w", "1000"]
+    if exclusive:
+        cmd.append("-x")
+    proc = subprocess.Popen(cmd, stderr=subprocess.DEVNULL)
     wait_listening(port)
-    yield {"port": port, "config_dir": config_dir, "uuid": uuid}
+    return proc, {"port": port, "config_dir": config_dir, "uuid": uuid}
+
+
+@pytest.fixture
+def tokend(tmp_path):
+    """Concurrent-mode (default) tokend, two pods at 0.5/0.3."""
+    proc, info = _start_tokend(tmp_path)
+    yield info
+    proc.kill()
+    proc.wait()
+
+
+@pytest.fixture
+def tokend_exclusive(tmp_path):
+    """Exclusive-mode (-x, Gemini-parity) tokend."""
+    proc, info = _start_tokend(tmp_path, exclusive=True)
+    yield info
     proc.kill()
     proc.wait()
 
@@ -72,9 +86,9 @@ class TestTokend:
         assert '"ns/pod-a"' in client.stat()
         client.close()
 
-    def test_exclusive_token(self, tokend):
-        a = TokenClient("127.0.0.1", tokend["port"], "ns/pod-a")
-        b = TokenClient("127.0.0.1", tokend["port"], "ns/pod-b")
+    def test_exclusive_token(self, tokend_exclusive):
+        a = TokenClient("127.0.0.1", tokend_exclusive["port"], "ns/pod-a")
+        b = TokenClient("127.0.0.1", tokend_exclusive["port"], "ns/pod-b")
         a.acquire()
         granted = []
 
@@ -91,6 +105,39 @@ class TestTokend:
         t.join(timeout=5)
         assert granted
         a.close(); b.close()
+
+    def test_concurrent_holders(self, tokend):
+        # default mode: both pods may hold tokens simultaneously
+        a = TokenClient("127.0.0.1", tokend["port"], "ns/pod-a")
+        b = TokenClient("127.0.0.1", tokend["port"], "ns/pod-b")
+        assert a.acquire() > 0
+        assert b.acquire() > 0  # does not block
+        import json
+
+        stat = json.loads(a.stat())
+        assert stat["mode"] == "concurrent" and stat["holders"] == 2
+        a.release(1.0); b.release(1.0)
+        a.close(); b.close()
+
+    def test_limit_cap_throttles(self, tmp_path):
+        # pod capped at limit 0.2 of a 1000ms window; charging 100ms per
+        # token must throttle grant rate to ~2 per window
+        proc, info = _start_tokend(tmp_path, config="1\nns/greedy 0.2 0.1 0\n")
+        try:
+            client = TokenClient("127.0.0.1", info["port"], "ns/greedy")
+            grants = 0
+            start = time.monotonic()
+            while time.monotonic() - start < 1.5:
+                client.acquire()
+                client.release(100.0)  # claims 100ms device time per token
+                grants += 1
+            client.close()
+            # uncapped this loop does hundreds of grants; the 0.2 limit
+            # allows roughly 0.2*1000ms/100ms = 2 per window plus decay slack
+            assert grants <= 8, grants
+        finally:
+            proc.kill()
+            proc.wait()
 
     def test_memory_cap(self, tokend):
         client = TokenClient("127.0.0.1", tokend["port"], "ns/pod-b")
