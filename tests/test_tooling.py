@@ -1,0 +1,117 @@
+"""Simulator, checkpoint, and CLI entry-point tests."""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeshare_tpu.parallel.checkpoint import (
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from kubeshare_tpu.simulator import parse_trace, run_trace
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+TRACE = os.path.join(REPO, "examples", "trace-small.txt")
+
+
+class TestSimulator:
+    def test_parse_trace(self):
+        entries = parse_trace(TRACE)
+        assert len(entries) == 60
+        assert all(e.chips >= 1 for e in entries)
+
+    def test_run_trace(self):
+        report = run_trace(TRACE, nodes=4, chips_per_node=4)
+        assert report.submitted == 60
+        assert report.bound + report.unschedulable == report.submitted
+        assert report.bound > 40  # most of the trace fits a 16-chip cluster
+        assert report.completed == report.bound
+        assert report.wall_seconds < 30  # virtual clock, not live replay
+
+    def test_run_trace_custom_topology(self):
+        # heterogeneous config: inventory must match declared models/counts
+        config = os.path.join(REPO, "deploy", "config",
+                              "kubeshare-config-v4-cluster.yaml")
+        report = run_trace(TRACE, topology_path=config)
+        assert report.submitted == 60
+        nodes = set(report.placements_per_node)
+        assert nodes <= {"tpu-v4-host-a", "tpu-v4-host-b", "tpu-v5e-host-c"}
+
+    def test_cli_simulate(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "kubeshare_tpu", "simulate",
+             "--trace", TRACE, "--nodes", "2", "--chips-per-node", "4"],
+            capture_output=True, text=True, cwd=REPO, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr
+        report = json.loads(out.stdout.strip().splitlines()[-1])
+        assert report["submitted"] == 60
+
+
+class TestCheckpoint:
+    def test_save_restore_roundtrip(self, tmp_path):
+        state = {"w": jnp.arange(6.0).reshape(2, 3), "step": jnp.int32(7)}
+        save_checkpoint(str(tmp_path), state, step=7)
+        restored = restore_checkpoint(str(tmp_path))
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.asarray(state["w"]))
+        assert int(restored["step"]) == 7
+
+    def test_latest_and_gc(self, tmp_path):
+        state = {"x": jnp.zeros(2)}
+        for step in (1, 5, 3, 9, 12):
+            save_checkpoint(str(tmp_path), state, step=step, keep=3)
+        step, path = latest_checkpoint(str(tmp_path))
+        assert step == 12 and path.endswith("ckpt-12.bin")
+        remaining = sorted(os.listdir(tmp_path))
+        assert len(remaining) == 3  # keep=3
+
+    def test_restore_trainstate(self, tmp_path):
+        from kubeshare_tpu.models import mnist_apply, mnist_init
+        from kubeshare_tpu.parallel.train import make_train_step
+
+        init_state, train_step = make_train_step(mnist_apply)
+        state = init_state(mnist_init(jax.random.PRNGKey(0)))
+        images = jnp.zeros((2, 28, 28, 1))
+        labels = jnp.zeros((2,), jnp.int32)
+        state, _ = train_step(state, images, labels)
+        save_checkpoint(str(tmp_path), state, step=int(state.step))
+        restored = restore_checkpoint(str(tmp_path))
+        assert int(restored.step) == 1
+        # resume training from the restored state
+        state2, loss = train_step(restored, images, labels)
+        assert int(state2.step) == 2 and np.isfinite(float(loss))
+
+
+class TestCLI:
+    def test_collector_cli(self):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kubeshare_tpu", "collector",
+             "--fake-chips", "2", "--port", "0", "--node-name", "cli-node"],
+            cwd=REPO, stderr=subprocess.PIPE, text=True,
+        )
+        try:
+            # port 0 is ephemeral; read it from the log line
+            line = proc.stderr.readline()
+            port = int(line.rsplit(":", 1)[-1].split("/")[0])
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/kubeshare-collector", timeout=5
+            ).read().decode()
+            assert body.count('node="cli-node"') == 2
+        finally:
+            proc.kill()
+            proc.wait()
+
+    def test_unknown_component(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "kubeshare_tpu", "nonsense"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert out.returncode != 0
