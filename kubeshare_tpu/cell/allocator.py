@@ -161,7 +161,14 @@ class CellAllocator:
         self, node: str, model: str, request: float, memory: int
     ) -> Tuple[bool, float, int]:
         """Can this node fit (request, memory) on chips of ``model``?
-        Returns (fit, available, free_memory) (ref filter.go:5-28)."""
+        Returns (fit, available, free_memory) (ref filter.go:5-28).
+
+        ``memory == 0`` means "no explicit cap": the fit check then demands
+        request * chip_HBM per leaf — the same default Reserve will charge
+        (ref pod.go:419-422) — otherwise a filter-passing pod could drive a
+        chip's free HBM negative at reserve time (latent reference bug:
+        its Filter checked 0 while Reserve charged the default).
+        """
         ok = False
         available = 0.0
         free_memory = 0
@@ -211,14 +218,12 @@ class CellAllocator:
 
         while stack:
             current = stack.pop()
-            if (
-                current.node == node
-                and current.healthy
-                and current.level == 1
-                and current.available >= request
-                and current.free_memory >= memory
-            ):
-                return True, current.available, current.free_memory
+            if current.node == node and current.healthy and current.level == 1:
+                required = memory if memory > 0 else int(
+                    math.floor(request * current.full_memory)
+                )
+                if current.available >= request and current.free_memory >= required:
+                    return True, current.available, current.free_memory
             for child in current.children:
                 if child.node in ("", node) and child.healthy:
                     stack.append(child)

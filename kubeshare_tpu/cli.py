@@ -156,6 +156,13 @@ def cmd_scheduler(args: argparse.Namespace) -> int:
         log_dir=args.log_dir,
     )
     engine = SchedulerEngine(plugin, cluster)
+    metric_server = None
+    if args.metrics_port >= 0:
+        from .utils.promtext import MetricServer
+
+        metric_server = MetricServer(plugin.collect_metrics, port=args.metrics_port)
+        metric_server.start()
+        log.info("scheduler metrics on :%d/metrics", metric_server.port)
     log.info("scheduler running (bind_mode=%s)", args.bind_mode)
     stop = _install_stop()
     while not stop:
@@ -168,6 +175,8 @@ def cmd_scheduler(args: argparse.Namespace) -> int:
             if result.result in ("unschedulable", "error"):
                 # back off instead of hot-spinning on a stuck head-of-queue
                 time.sleep(args.idle_interval)
+    if metric_server is not None:
+        metric_server.stop()
     return 0
 
 
@@ -256,6 +265,8 @@ def main(argv=None) -> int:
     p.add_argument("--collector-urls", default="")
     p.add_argument("--bind-mode", default="patch", choices=["patch", "shadow"])
     p.add_argument("--idle-interval", type=float, default=0.5)
+    p.add_argument("--metrics-port", type=int, default=9006,
+                   help="scheduler-state metrics port; -1 disables")
     p.set_defaults(fn=cmd_scheduler)
 
     p = sub.add_parser("simulate", help="trace-driven load simulation "

@@ -79,18 +79,7 @@ class ChipSupervisor:
         if not os.path.exists(config_path):
             with open(config_path, "w") as f:
                 f.write("0\n")
-        self.tokend = subprocess.Popen(
-            [
-                self.tokend_binary,
-                "-p", self.config_dir,
-                "-f", self.chip_uuid,
-                "-P", str(self.tokend_port),
-                "-q", str(self.base_quota_ms),
-                "-m", str(self.min_quota_ms),
-                "-w", str(self.window_ms),
-            ],
-            start_new_session=True,
-        )
+        self._spawn_tokend()
         self.reconcile()
         self._thread = threading.Thread(target=self._watch_loop, daemon=True)
         self._thread.start()
@@ -109,7 +98,40 @@ class ChipSupervisor:
                     self.reconcile()
                 except Exception as e:  # tolerate torn/partial content
                     self.log.warning("reconcile failed: %s", e)
+            self._check_processes()
             self._stop.wait(self.poll_interval)
+
+    def _check_processes(self) -> None:
+        """Failure detection: restart a crashed tokend; reap+respawn dead
+        pod managers (the reference launcher dies with its children,
+        ref launcher.py:100-110 — here the supervisor self-heals)."""
+        if self.tokend is not None and self.tokend.poll() is not None:
+            self.log.warning(
+                "tokend for %s exited with %s; restarting",
+                self.chip_uuid, self.tokend.returncode,
+            )
+            self._spawn_tokend()
+        dead = [key for key, proc in self.pod_managers.items()
+                if proc.poll() is not None]
+        for key in dead:
+            self.log.warning("pod manager %r died; respawning", key)
+            del self.pod_managers[key]
+        if dead:
+            self.reconcile()
+
+    def _spawn_tokend(self) -> None:
+        self.tokend = subprocess.Popen(
+            [
+                self.tokend_binary,
+                "-p", self.config_dir,
+                "-f", self.chip_uuid,
+                "-P", str(self.tokend_port),
+                "-q", str(self.base_quota_ms),
+                "-m", str(self.min_quota_ms),
+                "-w", str(self.window_ms),
+            ],
+            start_new_session=True,
+        )
 
     # ------------------------------------------------------------------
     def read_port_file(self) -> Dict[str, str]:

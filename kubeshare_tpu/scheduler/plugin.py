@@ -486,9 +486,15 @@ class KubeShareScheduler:
             if multi:
                 chosen.append(cell)
                 remaining -= 1.0
-            elif cell.available >= remaining and cell.free_memory >= status.memory:
-                chosen.append(cell)
-                remaining = 0
+            else:
+                # same implicit-HBM default as the filter: no explicit cap
+                # means request * chip HBM will be charged at reserve
+                required = status.memory if status.memory > 0 else int(
+                    math.floor(remaining * cell.full_memory)
+                )
+                if cell.available >= remaining and cell.free_memory >= required:
+                    chosen.append(cell)
+                    remaining = 0
             if remaining <= 0:
                 break
         if remaining > 0:
@@ -603,6 +609,37 @@ class KubeShareScheduler:
             timeout = self.args.permit_waiting_time_base_seconds * info.head_count
             return Status(Status.WAIT), timeout
         return Status(Status.SUCCESS), 0.0
+
+    # ------------------------------------------------------------------
+    # observability: scheduler-state metrics (beyond the reference's
+    # log-only story, SURVEY §5)
+    # ------------------------------------------------------------------
+    def collect_metrics(self):
+        from ..utils.promtext import MetricFamily
+
+        pods = MetricFamily(
+            "kubeshare_scheduler_pods", "Pods tracked by the scheduler.", "gauge"
+        )
+        with self.pod_status_lock:
+            statuses = list(self.pod_status.values())
+        placed = sum(1 for s in statuses if s.cells)
+        pods.add({"state": "tracked"}, len(statuses))
+        pods.add({"state": "placed"}, placed)
+
+        cells = MetricFamily(
+            "kubeshare_cell_available",
+            "Fractional availability per leaf cell.", "gauge",
+        )
+        memory = MetricFamily(
+            "kubeshare_cell_free_memory_bytes",
+            "Free HBM per leaf cell.", "gauge",
+        )
+        with self.allocator.lock:
+            for uuid, leaf in self.allocator.leaf_cells.items():
+                labels = {"uuid": uuid, "node": leaf.node, "model": leaf.cell_type}
+                cells.add(labels, leaf.available)
+                memory.add(labels, leaf.free_memory)
+        return [pods, cells, memory]
 
     # ------------------------------------------------------------------
     # teardown + recovery (ref pod.go:91-136, 528-617)

@@ -1,0 +1,192 @@
+"""Soak + invariant tests: a large random workload stream through the
+scheduler must never oversubscribe any chip (fraction or HBM), and the
+supervisor must self-heal crashed runtime processes."""
+
+import os
+import random
+import signal
+import time
+
+from kubeshare_tpu import constants
+from kubeshare_tpu.cell import load_config
+from kubeshare_tpu.cell.allocator import ChipInfo
+from kubeshare_tpu.cluster.api import FakeClock, Node, Pod
+from kubeshare_tpu.cluster.fake import FakeCluster
+from kubeshare_tpu.scheduler import KubeShareScheduler, SchedulerEngine
+
+import pytest
+
+from kubeshare_tpu.runtime import find_binary
+
+TOPOLOGY = """
+cellTypes:
+  V4-NODE:
+    childCellType: "TPU-v4"
+    childCellNumber: 4
+    childCellPriority: 60
+    isNodeLevel: true
+  2-V4-NODE:
+    childCellType: V4-NODE
+    childCellNumber: 2
+  V5E-NODE:
+    childCellType: "TPU-v5e"
+    childCellNumber: 8
+    childCellPriority: 80
+    isNodeLevel: true
+cells:
+- cellType: 2-V4-NODE
+  cellChildren:
+  - cellId: host-a
+  - cellId: host-b
+- cellType: V5E-NODE
+  cellId: host-c
+"""
+
+HBM = 32 << 30
+INVENTORY = {
+    "host-a": [ChipInfo(f"host-a-tpu-{i}", HBM, "TPU-v4", i) for i in range(4)],
+    "host-b": [ChipInfo(f"host-b-tpu-{i}", HBM, "TPU-v4", i) for i in range(4)],
+    "host-c": [ChipInfo(f"host-c-tpu-{i}", 16 << 30, "TPU-v5e", i) for i in range(8)],
+}
+
+
+def check_invariants(plugin):
+    """No chip oversubscribed, ever (fraction in [0,1], free HBM in
+    [0, full], port uniqueness per node)."""
+    for uuid, leaf in plugin.allocator.leaf_cells.items():
+        assert -1e-9 <= leaf.available <= 1.0 + 1e-9, (uuid, leaf.available)
+        assert -1 <= leaf.free_memory <= leaf.full_memory, (uuid, leaf.free_memory)
+    ports = {}
+    with plugin.pod_status_lock:
+        for status in plugin.pod_status.values():
+            if status.port >= constants.POD_MANAGER_PORT_START:
+                key = (status.node_name, status.port)
+                assert key not in ports, f"duplicate port {key}"
+                ports[key] = status.key
+
+
+def test_random_churn_never_oversubscribes():
+    rng = random.Random(7)
+    cluster = FakeCluster()
+    for node in INVENTORY:
+        cluster.add_node(Node(node, {constants.NODE_LABEL_FILTER: "true"}))
+    clock = FakeClock(0.0)
+    plugin = KubeShareScheduler(
+        load_config(text=TOPOLOGY), cluster, lambda n: INVENTORY.get(n, []),
+        clock=clock,
+    )
+    engine = SchedulerEngine(plugin, cluster, clock)
+
+    live = []
+    counter = 0
+    for round_idx in range(120):
+        action = rng.random()
+        if action < 0.6 or not live:
+            counter += 1
+            kind = rng.random()
+            labels = {constants.POD_GPU_LIMIT: "1.0"}
+            if kind < 0.5:
+                labels[constants.POD_GPU_REQUEST] = str(
+                    round(rng.uniform(0.05, 1.0), 2)
+                )
+                labels[constants.POD_GPU_MEMORY] = str(
+                    rng.randrange(1 << 30, 12 << 30)
+                )
+            elif kind < 0.7:
+                whole = rng.choice([1, 2, 3, 4])
+                labels[constants.POD_GPU_REQUEST] = f"{whole}.0"
+                labels[constants.POD_GPU_LIMIT] = f"{whole}.0"
+            else:
+                labels[constants.POD_GPU_REQUEST] = str(
+                    round(rng.uniform(0.1, 0.5), 2)
+                )
+                labels[constants.POD_PRIORITY] = str(rng.choice([0, 50, 100]))
+            if rng.random() < 0.3:
+                labels[constants.POD_GPU_MODEL] = rng.choice(["TPU-v4", "TPU-v5e"])
+            pod = Pod(name=f"churn-{counter}", labels=labels,
+                      scheduler_name=constants.SCHEDULER_NAME)
+            cluster.create_pod(pod)
+            live.append(pod.name)
+        else:
+            victim = live.pop(rng.randrange(len(live)))
+            cluster.delete_pod("default", victim)
+        engine.run_until_idle(max_cycles=60)
+        clock.advance(1.0)
+        check_invariants(plugin)
+
+    # drain everything: all capacity must return
+    for name in live:
+        cluster.delete_pod("default", name)
+    for uuid, leaf in plugin.allocator.leaf_cells.items():
+        assert abs(leaf.available - 1.0) < 1e-9, (uuid, leaf.available)
+        assert leaf.free_memory == leaf.full_memory, uuid
+
+
+def test_node_flap_under_load():
+    cluster = FakeCluster()
+    for node in INVENTORY:
+        cluster.add_node(Node(node, {constants.NODE_LABEL_FILTER: "true"}))
+    clock = FakeClock(0.0)
+    plugin = KubeShareScheduler(
+        load_config(text=TOPOLOGY), cluster, lambda n: INVENTORY.get(n, []),
+        clock=clock,
+    )
+    engine = SchedulerEngine(plugin, cluster, clock)
+    for i in range(6):
+        cluster.create_pod(Pod(
+            name=f"p{i}",
+            labels={constants.POD_GPU_REQUEST: "0.5",
+                    constants.POD_GPU_LIMIT: "1.0"},
+            scheduler_name=constants.SCHEDULER_NAME,
+        ))
+    engine.run_until_idle()
+    check_invariants(plugin)
+    # flap host-a several times; reservations must survive
+    for _ in range(3):
+        cluster.update_node(Node("host-a", {constants.NODE_LABEL_FILTER: "true"},
+                                 ready=False))
+        cluster.update_node(Node("host-a", {constants.NODE_LABEL_FILTER: "true"},
+                                 ready=True))
+        check_invariants(plugin)
+    placed = [p for p in cluster.list_pods() if p.is_bound()]
+    assert len(placed) == 6
+
+
+@pytest.mark.skipif(find_binary("tpushare-tokend") is None,
+                    reason="native binaries not built")
+def test_supervisor_restarts_crashed_tokend(tmp_path):
+    import socket
+
+    from kubeshare_tpu.runtime import ChipSupervisor
+    from kubeshare_tpu.utils.atomicfile import write_atomic
+
+    config_dir = tmp_path / "config"
+    port_dir = tmp_path / "ports"
+    config_dir.mkdir(); port_dir.mkdir()
+    write_atomic(str(config_dir / "chip-0"), "1\nns/p 1.0 0.5 0\n")
+    write_atomic(str(port_dir / "chip-0"), "0\n")
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    tokend_port = s.getsockname()[1]; s.close()
+    with ChipSupervisor(
+        "chip-0", config_dir=str(config_dir), port_dir=str(port_dir),
+        tokend_port=tokend_port, poll_interval=0.1,
+    ) as supervisor:
+        first_pid = supervisor.tokend.pid
+        os.kill(first_pid, signal.SIGKILL)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if (supervisor.tokend.pid != first_pid
+                    and supervisor.tokend.poll() is None):
+                break
+            time.sleep(0.1)
+        assert supervisor.tokend.pid != first_pid
+        # the restarted tokend serves again
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", tokend_port), timeout=1).close()
+                break
+            except OSError:
+                time.sleep(0.1)
+        else:
+            raise AssertionError("restarted tokend never listened")
