@@ -7,9 +7,16 @@ The TPU-native enforcement points (SURVEY §7.2):
   jitted step, ``block_until_ready``, release with measured wall time.
   This is the in-process equivalent of the PJRT interposer's Execute hook
   (and what Gemini did per kernel burst).
-- **HBM cap**: TPU clients allocate most HBM at client init, so the cap must
-  land *before* jax initializes (SURVEY §7.4) — ``apply_hbm_cap`` translates
-  the scheduler-injected TPUSHARE_MEM_FRACTION into XLA client flags.
+- **HBM cap**, three reinforcing levels (strongest first):
+  1. placement admission — the scheduler only co-locates pods whose HBM
+     requests fit the chip (the hard guarantee, like k8s memory requests);
+  2. broker accounting — the PJRT interposer charges every host->device
+     upload against the pod's cap via the MEM protocol (credited on buffer
+     destroy); over-cap pods are flagged to the operator (soft deny);
+  3. client flags — ``apply_hbm_cap`` translates the scheduler-injected
+     TPUSHARE_MEM_FRACTION into XLA client allocator flags where the
+     backend honors them (GPU yes; TPU runtimes currently ignore the
+     fraction knob, which is why levels 1-2 carry the enforcement).
 """
 
 from __future__ import annotations

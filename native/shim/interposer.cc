@@ -30,6 +30,7 @@ extern "C" {
 int tpushare_init_from_env(void);
 double tpushare_acquire(double est_ms);
 int tpushare_release(double used_ms);
+int tpushare_mem_request(long long delta_bytes);
 }
 
 namespace {
@@ -37,6 +38,11 @@ namespace {
 typedef const PJRT_Api* (*GetPjrtApiFn)(void);
 
 PJRT_Error* (*g_real_execute)(PJRT_LoadedExecutable_Execute_Args*) = nullptr;
+PJRT_Error* (*g_real_buffer_from_host)(PJRT_Client_BufferFromHostBuffer_Args*) =
+    nullptr;
+PJRT_Error* (*g_real_buffer_destroy)(PJRT_Buffer_Destroy_Args*) = nullptr;
+PJRT_Error* (*g_real_buffer_on_device_size)(
+    PJRT_Buffer_OnDeviceSizeInBytes_Args*) = nullptr;
 bool g_gated = false;
 double g_estimate_ms = 1.0;  // EMA of observed execution wall time
 
@@ -44,6 +50,64 @@ double NowMs() {
   return std::chrono::duration<double, std::milli>(
              std::chrono::steady_clock::now().time_since_epoch())
       .count();
+}
+
+// HBM accounting: charge host->device uploads against the pod's cap via
+// the broker's MEM protocol and credit them back on buffer destruction.
+// Over-cap allocations are logged (soft enforcement; the scheduler already
+// guarantees placement-time fit — this catches misbehaving pods for the
+// operator, with hard denial a follow-up once PJRT error fabrication is
+// plumbed).
+long long ElementBytes(PJRT_Buffer_Type type) {
+  switch (type) {
+    case PJRT_Buffer_Type_PRED:
+    case PJRT_Buffer_Type_S8:
+    case PJRT_Buffer_Type_U8:
+      return 1;
+    case PJRT_Buffer_Type_S16:
+    case PJRT_Buffer_Type_U16:
+    case PJRT_Buffer_Type_F16:
+    case PJRT_Buffer_Type_BF16:
+      return 2;
+    case PJRT_Buffer_Type_S64:
+    case PJRT_Buffer_Type_U64:
+    case PJRT_Buffer_Type_F64:
+    case PJRT_Buffer_Type_C64:
+      return 8;
+    case PJRT_Buffer_Type_C128:
+      return 16;
+    default:
+      return 4;  // S32/U32/F32 and a safe default for exotic types
+  }
+}
+
+PJRT_Error* HookedBufferFromHost(PJRT_Client_BufferFromHostBuffer_Args* args) {
+  if (g_gated && args->dims != nullptr) {
+    long long elements = 1;
+    for (size_t i = 0; i < args->num_dims; i++) elements *= args->dims[i];
+    long long bytes = elements * ElementBytes(args->type);
+    if (tpushare_mem_request(bytes) == 0) {
+      std::fprintf(stderr,
+                   "tpushim: HBM cap exceeded by %lld-byte upload "
+                   "(soft-deny; accounted)\n", bytes);
+    }
+  }
+  return g_real_buffer_from_host(args);
+}
+
+PJRT_Error* HookedBufferDestroy(PJRT_Buffer_Destroy_Args* args) {
+  if (g_gated && g_real_buffer_on_device_size != nullptr) {
+    PJRT_Buffer_OnDeviceSizeInBytes_Args size_args;
+    std::memset(&size_args, 0, sizeof(size_args));
+    size_args.struct_size = PJRT_Buffer_OnDeviceSizeInBytes_Args_STRUCT_SIZE;
+    size_args.buffer = args->buffer;
+    PJRT_Error* err = g_real_buffer_on_device_size(&size_args);
+    if (err == nullptr && size_args.on_device_size_in_bytes > 0) {
+      tpushare_mem_request(
+          -static_cast<long long>(size_args.on_device_size_in_bytes));
+    }
+  }
+  return g_real_buffer_destroy(args);
 }
 
 PJRT_Error* HookedExecute(PJRT_LoadedExecutable_Execute_Args* args) {
@@ -77,6 +141,15 @@ const PJRT_Api* WrapApi(const PJRT_Api* real) {
     std::memcpy(&wrapped, real, sizeof(PJRT_Api));
     g_real_execute = wrapped.PJRT_LoadedExecutable_Execute;
     wrapped.PJRT_LoadedExecutable_Execute = HookedExecute;
+    g_real_buffer_from_host = wrapped.PJRT_Client_BufferFromHostBuffer;
+    g_real_buffer_destroy = wrapped.PJRT_Buffer_Destroy;
+    g_real_buffer_on_device_size = wrapped.PJRT_Buffer_OnDeviceSizeInBytes;
+    if (g_real_buffer_from_host != nullptr) {
+      wrapped.PJRT_Client_BufferFromHostBuffer = HookedBufferFromHost;
+    }
+    if (g_real_buffer_destroy != nullptr) {
+      wrapped.PJRT_Buffer_Destroy = HookedBufferDestroy;
+    }
     g_gated = tpushare_init_from_env() == 0;
     if (!g_gated) {
       std::fprintf(stderr,

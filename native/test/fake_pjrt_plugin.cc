@@ -10,9 +10,27 @@
 namespace {
 
 int g_execute_calls = 0;
+int g_buffer_calls = 0;
+int g_destroy_calls = 0;
 
 PJRT_Error* FakeExecute(PJRT_LoadedExecutable_Execute_Args*) {
   g_execute_calls++;
+  return nullptr;
+}
+
+PJRT_Error* FakeBufferFromHost(PJRT_Client_BufferFromHostBuffer_Args* args) {
+  g_buffer_calls++;
+  args->buffer = reinterpret_cast<PJRT_Buffer*>(0x1);  // opaque fake handle
+  return nullptr;
+}
+
+PJRT_Error* FakeBufferDestroy(PJRT_Buffer_Destroy_Args*) {
+  g_destroy_calls++;
+  return nullptr;
+}
+
+PJRT_Error* FakeOnDeviceSize(PJRT_Buffer_OnDeviceSizeInBytes_Args* args) {
+  args->on_device_size_in_bytes = 4096;
   return nullptr;
 }
 
@@ -21,6 +39,8 @@ PJRT_Error* FakeExecute(PJRT_LoadedExecutable_Execute_Args*) {
 extern "C" {
 
 int fake_execute_calls(void) { return g_execute_calls; }
+int fake_buffer_calls(void) { return g_buffer_calls; }
+int fake_destroy_calls(void) { return g_destroy_calls; }
 
 const PJRT_Api* GetPjrtApi(void) {
   static PJRT_Api api;
@@ -31,6 +51,9 @@ const PJRT_Api* GetPjrtApi(void) {
     api.pjrt_api_version.major_version = PJRT_API_MAJOR;
     api.pjrt_api_version.minor_version = PJRT_API_MINOR;
     api.PJRT_LoadedExecutable_Execute = FakeExecute;
+    api.PJRT_Client_BufferFromHostBuffer = FakeBufferFromHost;
+    api.PJRT_Buffer_Destroy = FakeBufferDestroy;
+    api.PJRT_Buffer_OnDeviceSizeInBytes = FakeOnDeviceSize;
     initialized = true;
   }
   return &api;

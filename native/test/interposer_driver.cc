@@ -9,6 +9,7 @@
 
 #include <cstdio>
 #include <cstdlib>
+#include <cstring>
 
 #include "xla/pjrt/c/pjrt_c_api.h"
 
@@ -38,7 +39,29 @@ int main(int argc, char** argv) {
   for (int i = 0; i < n; i++) {
     api->PJRT_LoadedExecutable_Execute(&args);
   }
+  // one host->device upload of a [256, 4] f32 array (4096 bytes), destroyed
+  // again: exercises the HBM accounting hooks
+  if (api->PJRT_Client_BufferFromHostBuffer != nullptr) {
+    PJRT_Client_BufferFromHostBuffer_Args buffer_args;
+    std::memset(&buffer_args, 0, sizeof(buffer_args));
+    buffer_args.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+    int64_t dims[2] = {256, 4};
+    buffer_args.type = PJRT_Buffer_Type_F32;
+    buffer_args.dims = dims;
+    buffer_args.num_dims = 2;
+    api->PJRT_Client_BufferFromHostBuffer(&buffer_args);
+    if (api->PJRT_Buffer_Destroy != nullptr && buffer_args.buffer != nullptr) {
+      PJRT_Buffer_Destroy_Args destroy_args;
+      std::memset(&destroy_args, 0, sizeof(destroy_args));
+      destroy_args.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+      destroy_args.buffer = buffer_args.buffer;
+      api->PJRT_Buffer_Destroy(&destroy_args);
+    }
+  }
   auto calls = reinterpret_cast<int (*)()>(dlsym(handle, "fake_execute_calls"));
-  std::printf("executed %d real_calls %d\n", n, calls != nullptr ? calls() : -1);
+  auto buffers = reinterpret_cast<int (*)()>(dlsym(handle, "fake_buffer_calls"));
+  std::printf("executed %d real_calls %d buffers %d\n", n,
+              calls != nullptr ? calls() : -1,
+              buffers != nullptr ? buffers() : -1);
   return 0;
 }

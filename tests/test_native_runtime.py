@@ -351,7 +351,7 @@ class TestInterposer:
                 timeout=60,
             )
             assert out.returncode == 0, out.stderr
-            assert "executed 7 real_calls 7" in out.stdout
+            assert "executed 7 real_calls 7 buffers 1" in out.stdout
             # every execute acquired a token: grants visible in tokend
             import json
 
@@ -359,6 +359,9 @@ class TestInterposer:
             pods = json.loads(client.stat())["pods"]
             client.close()
             assert pods["ns/pod-a"]["grants"] == 7
+            # HBM accounting: 4096-byte upload charged then credited on
+            # destroy -> net zero but the path executed
+            assert pods["ns/pod-a"]["mem_used"] == 0
         finally:
             pmgr.kill()
             pmgr.wait()
@@ -372,4 +375,4 @@ class TestInterposer:
             timeout=60,
         )
         assert out.returncode == 0, out.stderr
-        assert "executed 3 real_calls 3" in out.stdout
+        assert "executed 3 real_calls 3 buffers 1" in out.stdout
