@@ -1,0 +1,42 @@
+"""Profiling helpers (beyond the reference's log-only observability).
+
+Thin wrappers over jax.profiler so workloads and benches capture XLA/TPU
+traces (viewable in TensorBoard/Perfetto) without importing profiler
+plumbing everywhere.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+from .logger import get_logger
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Capture a device trace into ``log_dir`` (no-op when None)."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def timed(label: str, logger_name: str = "kubeshare-profile") -> Iterator[dict]:
+    """Wall-time a block; yields a dict that receives ``seconds``."""
+    log = get_logger(logger_name)
+    result: dict = {}
+    start = time.perf_counter()
+    try:
+        yield result
+    finally:
+        result["seconds"] = time.perf_counter() - start
+        log.info("%s took %.3fs", label, result["seconds"])

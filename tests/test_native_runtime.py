@@ -376,3 +376,53 @@ class TestInterposer:
         )
         assert out.returncode == 0, out.stderr
         assert "executed 3 real_calls 3 buffers 1" in out.stdout
+
+
+class TestTsan:
+    """Race detection for the token scheduler: hammer a TSAN build with
+    concurrent clients; any data race aborts the process / prints a
+    ThreadSanitizer report."""
+
+    def test_tokend_tsan_concurrent(self, tmp_path):
+        tsan_binary = find_binary("tpushare-tokend-tsan")
+        if tsan_binary is None:
+            pytest.skip("tsan build not present (make -C native tsan)")
+        config_dir = tmp_path / "config"
+        config_dir.mkdir()
+        write_atomic(str(config_dir / "chip-0"),
+                     "2\nns/a 1.0 0.5 100000\nns/b 1.0 0.3 100000\n")
+        port = free_port()
+        proc = subprocess.Popen(
+            [tsan_binary, "-p", str(config_dir), "-f", "chip-0",
+             "-P", str(port), "-q", "10", "-m", "2", "-w", "200"],
+            stderr=subprocess.PIPE, text=True,
+        )
+        try:
+            wait_listening(port)
+
+            def hammer(pod):
+                client = TokenClient("127.0.0.1", port, pod)
+                stop = time.monotonic() + 2.0
+                while time.monotonic() < stop:
+                    client.acquire()
+                    client.release(1.0)
+                    client.request_memory(10)
+                    client.request_memory(-10)
+                client.close()
+
+            threads = [threading.Thread(target=hammer, args=(p,))
+                       for p in ("ns/a", "ns/b", "ns/a", "ns/b")]
+            for t in threads:
+                t.start()
+            # concurrent config reloads while clients hammer
+            for i in range(5):
+                write_atomic(str(config_dir / "chip-0"),
+                             f"2\nns/a 1.0 0.{4+i%3} 100000\nns/b 1.0 0.3 100000\n")
+                time.sleep(0.3)
+            for t in threads:
+                t.join()
+            assert proc.poll() is None, "tokend died under TSAN"
+        finally:
+            proc.kill()
+            _, stderr = proc.communicate(timeout=10)
+        assert "ThreadSanitizer" not in (stderr or ""), stderr
