@@ -50,6 +50,9 @@ class CellAllocator:
         self.chip_infos: Dict[str, Dict[str, List[ChipInfo]]] = {}  # node -> model -> chips
         self.node_health: Dict[str, bool] = {}
         self.lock = threading.RLock()
+        # (node, model) -> healthy leaves; membership only changes on
+        # bind/health events, so Filter/Score walks hit this cache
+        self._leaf_cache: Dict[Tuple[str, str], List[Cell]] = {}
 
     # ------------------------------------------------------------------
     # inventory + health (ref node.go:109-285)
@@ -82,6 +85,7 @@ class CellAllocator:
         """
         with self.lock:
             self.node_health[node] = healthy
+            self._leaf_cache.clear()
             for free_list in self.free_list.values():
                 for cell_list in free_list.values():
                     for cell in cell_list:
@@ -233,16 +237,21 @@ class CellAllocator:
     # leaf queries (ref score.go:230-294)
     # ------------------------------------------------------------------
     def leaf_cells_by_node(self, node: str, model: str = "") -> List[Cell]:
-        result: List[Cell] = []
-        if model:
-            free_lists = [self.free_list.get(model, {})]
-        else:
-            free_lists = list(self.free_list.values())
-        for free_list in free_lists:
-            for cell_list in free_list.values():
-                for cell in cell_list:
-                    result.extend(self._leaves_of_node(cell, node))
-        return result
+        with self.lock:
+            cached = self._leaf_cache.get((node, model))
+            if cached is not None:
+                return list(cached)
+            result: List[Cell] = []
+            if model:
+                free_lists = [self.free_list.get(model, {})]
+            else:
+                free_lists = list(self.free_list.values())
+            for free_list in free_lists:
+                for cell_list in free_list.values():
+                    for cell in cell_list:
+                        result.extend(self._leaves_of_node(cell, node))
+            self._leaf_cache[(node, model)] = result
+            return list(result)
 
     def _leaves_of_node(self, cell: Cell, node: str) -> List[Cell]:
         if cell.node not in ("", node) or not cell.healthy:

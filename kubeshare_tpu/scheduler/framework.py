@@ -52,17 +52,32 @@ class SchedulerEngine:
         self.log = get_logger("kubeshare-engine")
         self._waiting: Dict[str, List[_WaitingPod]] = {}
         self._attempt_timestamps: Dict[str, float] = {}
+        self._sort_keys: Dict[str, tuple] = {}
+        self._sort_key_uids: Dict[str, str] = {}
 
     # ------------------------------------------------------------------
     def pending_pods(self) -> List[Pod]:
+        waiting_keys = {
+            w.pod.key for group in self._waiting.values() for w in group
+        }
         pods = [
             p
             for p in self.cluster.list_pods(scheduler_name=constants.SCHEDULER_NAME)
-            if not p.is_bound() and not p.is_completed() and not self._is_waiting(p)
+            if not p.is_bound() and not p.is_completed()
+            and p.key not in waiting_keys
         ]
+        # sort keys are stable per pod lifetime (priority + the group's
+        # initial-attempt timestamp), so cache them — the queue is re-sorted
+        # every cycle (ref QueueSort runs per comparison too, but against a
+        # heap, not a full list)
         for p in pods:
-            self._attempt_timestamps.setdefault(p.key, self.clock.now())
-        pods.sort(key=lambda p: self.plugin.sort_key(p, self._attempt_timestamps[p.key]))
+            if p.key not in self._sort_keys or self._sort_key_uids.get(p.key) != p.uid:
+                self._attempt_timestamps.setdefault(p.key, self.clock.now())
+                self._sort_keys[p.key] = self.plugin.sort_key(
+                    p, self._attempt_timestamps[p.key]
+                )
+                self._sort_key_uids[p.key] = p.uid
+        pods.sort(key=lambda p: self._sort_keys[p.key])
         return pods
 
     def _is_waiting(self, pod: Pod) -> bool:
