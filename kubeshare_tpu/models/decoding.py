@@ -1,0 +1,109 @@
+"""Autoregressive decoding with a KV cache for the flagship Transformer.
+
+Serving-shaped workload path (the training side lives in parallel/train):
+prefill populates a static-shape KV cache, then a ``lax.scan`` decode loop
+generates tokens one at a time — everything static-shaped and jit-compiled
+once, the way TPU decoding must be (no growing arrays, no Python loop).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import TransformerConfig, _rms_norm
+
+
+def init_kv_cache(config: TransformerConfig, batch: int) -> Dict:
+    """Static [layers x batch x heads x max_seq x head_dim] cache."""
+    shape = (batch, config.n_heads, config.max_seq_len, config.head_dim)
+    return {
+        "k": jnp.zeros((config.n_layers, *shape), config.dtype),
+        "v": jnp.zeros((config.n_layers, *shape), config.dtype),
+        "length": jnp.zeros((), jnp.int32),
+    }
+
+
+def _attend_cached(q, cache_k, cache_v, length):
+    """q: [b,h,1,d] against cache [b,h,S,d]; positions >= length masked."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, cache_k).astype(jnp.float32) * scale
+    positions = jnp.arange(cache_k.shape[2])
+    scores = jnp.where(
+        positions[None, None, None, :] < length, scores, -jnp.inf
+    )
+    probs = jax.nn.softmax(scores, axis=-1).astype(cache_v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, cache_v)
+
+
+def _decode_one(params, config: TransformerConfig, cache: Dict, token: jax.Array):
+    """One decode step: token [batch] -> (logits [batch, vocab], cache)."""
+    dtype = config.dtype
+    position = cache["length"]
+    x = params["embed"][token].astype(dtype)[:, None, :]  # [b,1,d]
+    pos_embed = jax.lax.dynamic_slice_in_dim(params["pos_embed"], position, 1)
+    x = x + pos_embed.astype(dtype)
+
+    new_k, new_v = [], []
+    for layer_idx, layer in enumerate(params["layers"]):
+        y = _rms_norm(x, layer["norm1"]["scale"])
+        q = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wq"].astype(dtype))
+        k = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wk"].astype(dtype))
+        v = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wv"].astype(dtype))
+        cache_k = jax.lax.dynamic_update_slice_in_dim(
+            cache["k"][layer_idx], k, position, axis=2
+        )
+        cache_v = jax.lax.dynamic_update_slice_in_dim(
+            cache["v"][layer_idx], v, position, axis=2
+        )
+        new_k.append(cache_k)
+        new_v.append(cache_v)
+        o = _attend_cached(q, cache_k, cache_v, position + 1).astype(dtype)
+        x = x + jnp.einsum("bhsk,hkd->bsd", o, layer["attn"]["wo"].astype(dtype))
+        y = _rms_norm(x, layer["norm2"]["scale"])
+        y = jax.nn.gelu(y @ layer["mlp"]["w_in"].astype(dtype))
+        x = x + y @ layer["mlp"]["w_out"].astype(dtype)
+
+    x = _rms_norm(x, params["final_norm"]["scale"])
+    logits = (x[:, 0] @ params["lm_head"].astype(dtype)).astype(jnp.float32)
+    cache = {
+        "k": jnp.stack(new_k),
+        "v": jnp.stack(new_v),
+        "length": position + 1,
+    }
+    return logits, cache
+
+
+def prefill(params, config: TransformerConfig, prompt: jax.Array) -> Tuple[Dict, jax.Array]:
+    """Feed the prompt [batch, prompt_len] through the cache; returns
+    (cache, last_logits)."""
+    batch, prompt_len = prompt.shape
+    cache = init_kv_cache(config, batch)
+
+    def step(cache, token):
+        logits, cache = _decode_one(params, config, cache, token)
+        return cache, logits
+
+    cache, all_logits = jax.lax.scan(step, cache, prompt.T)
+    return cache, all_logits[-1]
+
+
+def greedy_decode(
+    params, config: TransformerConfig, prompt: jax.Array, max_new_tokens: int
+) -> jax.Array:
+    """Greedy generation: returns [batch, max_new_tokens] token ids.
+    Jit-compatible (static max_new_tokens)."""
+    cache, logits = prefill(params, config, prompt)
+
+    def step(carry, _):
+        cache, logits = carry
+        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        next_logits, cache = _decode_one(params, config, cache, token)
+        return (cache, next_logits), token
+
+    (_, _), tokens = jax.lax.scan(
+        step, (cache, logits), None, length=max_new_tokens
+    )
+    return tokens.T  # [batch, new_tokens]

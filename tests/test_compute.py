@@ -217,3 +217,42 @@ class TestRingTransformer:
         params = transformer_init(jax.random.PRNGKey(0), params_cfg)
         with pytest.raises(ValueError):
             transformer_apply(params, jnp.zeros((1, 8), jnp.int32), params_cfg)
+
+
+class TestDecoding:
+    def _setup(self):
+        from kubeshare_tpu.models.transformer import TransformerConfig, transformer_init
+
+        config = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq_len=32, dtype=jnp.float32, attention="reference",
+        )
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        return config, params
+
+    def test_incremental_matches_full_forward(self):
+        from kubeshare_tpu.models.decoding import prefill
+
+        config, params = self._setup()
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0, 64)
+        # cached incremental prefill must equal the dense forward's last step
+        dense = transformer_apply(params, prompt, config)
+        _, last_logits = prefill(params, config, prompt)
+        np.testing.assert_allclose(
+            np.asarray(dense[:, -1]), np.asarray(last_logits),
+            rtol=2e-4, atol=2e-4,
+        )
+
+    def test_greedy_decode_jits_and_is_deterministic(self):
+        from kubeshare_tpu.models.decoding import greedy_decode
+
+        config, params = self._setup()
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 4), 0, 64)
+        decode = jax.jit(
+            lambda p, t: greedy_decode(p, config, t, max_new_tokens=8)
+        )
+        out1 = decode(params, prompt)
+        out2 = decode(params, prompt)
+        assert out1.shape == (2, 8)
+        np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+        assert (np.asarray(out1) >= 0).all() and (np.asarray(out1) < 64).all()
