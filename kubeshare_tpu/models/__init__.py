@@ -1,8 +1,20 @@
 from .mnist import MnistConfig, mnist_init, mnist_apply
 from .resnet import ResNetConfig, resnet_init, resnet_apply
-from .transformer import TransformerConfig, transformer_init, transformer_apply
+from .transformer import (
+    TransformerConfig,
+    transformer_init,
+    transformer_apply,
+    transformer_apply_ring,
+    transformer_sharding_rules,
+)
+from .decoding import greedy_decode, init_kv_cache, prefill
 
 __all__ = [
+    "transformer_apply_ring",
+    "transformer_sharding_rules",
+    "greedy_decode",
+    "init_kv_cache",
+    "prefill",
     "MnistConfig",
     "mnist_init",
     "mnist_apply",

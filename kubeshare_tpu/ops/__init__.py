@@ -1,4 +1,14 @@
 from .attention import attention_reference, flash_attention
-from .ring_attention import ring_attention
+from .ring_attention import ring_attention, ring_attention_sharded
+from .moe import MoEConfig, moe_apply, moe_init, moe_sharding_rules
 
-__all__ = ["attention_reference", "flash_attention", "ring_attention"]
+__all__ = [
+    "attention_reference",
+    "flash_attention",
+    "ring_attention",
+    "ring_attention_sharded",
+    "MoEConfig",
+    "moe_apply",
+    "moe_init",
+    "moe_sharding_rules",
+]

@@ -1,11 +1,20 @@
-from .mesh import MeshSpec, make_mesh, batch_sharding, replicated
-from .train import TrainState, make_train_step
+from .mesh import MeshSpec, make_mesh, batch_sharding, replicated, shard_params
+from .train import TrainState, cross_entropy_loss, make_train_step
+from .pipeline import pipeline_apply, stack_stage_params
+from .checkpoint import latest_checkpoint, restore_checkpoint, save_checkpoint
 
 __all__ = [
     "MeshSpec",
     "make_mesh",
     "batch_sharding",
     "replicated",
+    "shard_params",
     "TrainState",
+    "cross_entropy_loss",
     "make_train_step",
+    "pipeline_apply",
+    "stack_stage_params",
+    "latest_checkpoint",
+    "restore_checkpoint",
+    "save_checkpoint",
 ]
