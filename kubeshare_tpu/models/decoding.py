@@ -80,6 +80,11 @@ def prefill(params, config: TransformerConfig, prompt: jax.Array) -> Tuple[Dict,
     """Feed the prompt [batch, prompt_len] through the cache; returns
     (cache, last_logits)."""
     batch, prompt_len = prompt.shape
+    if prompt_len > config.max_seq_len:
+        # dynamic_update_slice would silently clamp at the window edge
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds max_seq_len {config.max_seq_len}"
+        )
     cache = init_kv_cache(config, batch)
 
     def step(cache, token):
@@ -95,15 +100,27 @@ def greedy_decode(
 ) -> jax.Array:
     """Greedy generation: returns [batch, max_new_tokens] token ids.
     Jit-compatible (static max_new_tokens)."""
+    total = prompt.shape[1] + max_new_tokens
+    if total > config.max_seq_len:
+        # dynamic_update_slice would silently clamp at the window edge and
+        # overwrite the last cache slot; fail loudly instead
+        raise ValueError(
+            f"prompt ({prompt.shape[1]}) + max_new_tokens ({max_new_tokens}) "
+            f"= {total} exceeds max_seq_len {config.max_seq_len}"
+        )
     cache, logits = prefill(params, config, prompt)
+    first_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     def step(carry, _):
-        cache, logits = carry
-        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        cache, token = carry
         next_logits, cache = _decode_one(params, config, cache, token)
-        return (cache, next_logits), token
+        next_token = jnp.argmax(next_logits, axis=-1).astype(jnp.int32)
+        return (cache, next_token), next_token
 
-    (_, _), tokens = jax.lax.scan(
-        step, (cache, logits), None, length=max_new_tokens
+    # first token comes straight from the prefill logits; scan emits the
+    # remaining max_new_tokens-1 (no wasted trailing forward pass)
+    (_, _), rest = jax.lax.scan(
+        step, (cache, first_token), None, length=max_new_tokens - 1
     )
+    tokens = jnp.concatenate([first_token[None], rest], axis=0)
     return tokens.T  # [batch, new_tokens]

@@ -256,3 +256,13 @@ class TestDecoding:
         assert out1.shape == (2, 8)
         np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
         assert (np.asarray(out1) >= 0).all() and (np.asarray(out1) < 64).all()
+
+    def test_overflow_guards(self):
+        from kubeshare_tpu.models.decoding import greedy_decode, prefill
+
+        config, params = self._setup()
+        long_prompt = jnp.zeros((1, 40), jnp.int32)  # > max_seq_len 32
+        with pytest.raises(ValueError):
+            prefill(params, config, long_prompt)
+        with pytest.raises(ValueError):
+            greedy_decode(params, config, jnp.zeros((1, 30), jnp.int32), 10)
