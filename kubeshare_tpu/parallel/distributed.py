@@ -1,0 +1,92 @@
+"""Multi-host bootstrap: gang placement -> jax.distributed initialization.
+
+The reference's distributed workloads used TorchElastic's rendezvous over
+NCCL (SURVEY §2.10); the TPU-native equivalent is ``jax.distributed`` with
+XLA collectives over ICI/DCN.  The scheduler injects each gang member's
+coordinates (TPUSHARE_GANG_NAME/SIZE/RANK) at placement; the coordinator
+address comes from a headless service or an explicit env
+(TPUSHARE_COORDINATOR) — rank 0's address by convention.
+
+``initialize_from_env()`` is the one call a gang workload makes before
+importing-and-using jax for multi-host meshes.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Mapping, Optional
+
+from .. import constants
+from ..utils.logger import get_logger
+
+ENV_GANG_NAME = "TPUSHARE_GANG_NAME"
+ENV_GANG_SIZE = "TPUSHARE_GANG_SIZE"
+ENV_GANG_RANK = "TPUSHARE_GANG_RANK"
+ENV_COORDINATOR = "TPUSHARE_COORDINATOR"
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+@dataclass(frozen=True)
+class DistributedSpec:
+    coordinator_address: str
+    num_processes: int
+    process_id: int
+
+    @property
+    def is_multi_process(self) -> bool:
+        return self.num_processes > 1
+
+
+def spec_from_env(environ: Optional[Mapping[str, str]] = None) -> Optional[DistributedSpec]:
+    """Derive distributed-init arguments from the scheduler-injected env.
+
+    Returns None when the pod is not part of a multi-process gang (solo
+    pods and single-process gangs need no distributed init).
+    """
+    env = environ if environ is not None else os.environ
+    size_raw = env.get(ENV_GANG_SIZE)
+    rank_raw = env.get(ENV_GANG_RANK)
+    if not size_raw or rank_raw is None:
+        return None
+    try:
+        size = int(size_raw)
+        rank = int(rank_raw)
+    except ValueError:
+        return None
+    if size <= 1:
+        return None
+    if not 0 <= rank < size:
+        return None
+    coordinator = env.get(ENV_COORDINATOR, "")
+    if not coordinator:
+        # convention: a headless service resolving to rank 0, named after
+        # the gang (e.g. k8s `<gang>-0.<gang>` for a StatefulSet)
+        gang = env.get(ENV_GANG_NAME, "")
+        if not gang:
+            return None
+        coordinator = f"{gang}-0.{gang}:{DEFAULT_COORDINATOR_PORT}"
+    elif ":" not in coordinator:
+        coordinator = f"{coordinator}:{DEFAULT_COORDINATOR_PORT}"
+    return DistributedSpec(coordinator, size, rank)
+
+
+def initialize_from_env(environ: Optional[Mapping[str, str]] = None) -> Optional[DistributedSpec]:
+    """Call jax.distributed.initialize from gang env; no-op when solo."""
+    log = get_logger("kubeshare-distributed")
+    spec = spec_from_env(environ)
+    if spec is None:
+        log.info("no multi-process gang env; running single-process")
+        return None
+    import jax
+
+    log.info(
+        "initializing jax.distributed: coordinator=%s size=%d rank=%d",
+        spec.coordinator_address, spec.num_processes, spec.process_id,
+    )
+    jax.distributed.initialize(
+        coordinator_address=spec.coordinator_address,
+        num_processes=spec.num_processes,
+        process_id=spec.process_id,
+    )
+    return spec

@@ -557,6 +557,7 @@ class KubeShareScheduler:
             constants.ENV_MEM_BYTES: str(status.memory),
             constants.ENV_MEM_FRACTION: f"{mem_fraction:.4f}",
         }
+        env.update(self._gang_env(pod, status))
         for container in assumed.containers:
             container.env.update(env)
             container.volume_mounts.append(constants.LIBRARY_PATH)
@@ -590,9 +591,32 @@ class KubeShareScheduler:
             constants.ENV_VISIBLE_CHIPS: self._chip_indices(status.cells),
             constants.ENV_POD_NAME: pod.key,
         }
+        env.update(self._gang_env(pod, status))
         for container in assumed.containers:
             container.env.update(env)
         return assumed
+
+    def _gang_env(self, pod: Pod, status: PodStatus) -> Dict[str, str]:
+        """Gang coordinates for multi-host bootstrap (parallel.distributed):
+        rank = number of groupmates placed before this pod."""
+        if not status.pod_group:
+            return {}
+        info = self.pod_groups.get(f"{pod.namespace}/{status.pod_group}")
+        size = info.head_count if info is not None else status.min_available
+        rank = self.count_bound_group_pods(
+            pod.namespace, status.pod_group, exclude_key=pod.key
+        )
+        from ..parallel.distributed import (
+            ENV_GANG_NAME,
+            ENV_GANG_RANK,
+            ENV_GANG_SIZE,
+        )
+
+        return {
+            ENV_GANG_NAME: status.pod_group,
+            ENV_GANG_SIZE: str(size),
+            ENV_GANG_RANK: str(rank),
+        }
 
     # ------------------------------------------------------------------
     # Permit: the gang barrier (ref scheduler.go:551-587)

@@ -443,3 +443,59 @@ class TestReviewRegressions:
         leaf = plugin.allocator.leaf_cells[pod.annotations[constants.POD_GPU_UUID]]
         assert leaf.available == 0.5
         assert "default/s" in plugin.pod_status
+
+
+class TestGangEnv:
+    def test_gang_rank_injection(self):
+        from kubeshare_tpu.parallel.distributed import (
+            ENV_GANG_NAME, ENV_GANG_RANK, ENV_GANG_SIZE,
+        )
+
+        cluster, plugin, engine, _ = make_env()
+        for i in range(3):
+            cluster.create_pod(
+                shared_pod(f"w{i}", request="0.5", limit="1.0",
+                           group="ddp", headcount=3, threshold=1.0)
+            )
+        engine.run_until_idle()
+        ranks = set()
+        for i in range(3):
+            pod = cluster.get_pod("default", f"w{i}")
+            env = pod.containers[0].env
+            assert env[ENV_GANG_NAME] == "ddp"
+            assert env[ENV_GANG_SIZE] == "3"
+            ranks.add(env[ENV_GANG_RANK])
+        assert ranks == {"0", "1", "2"}
+
+    def test_solo_pod_gets_no_gang_env(self):
+        from kubeshare_tpu.parallel.distributed import ENV_GANG_NAME
+
+        cluster, plugin, engine, _ = make_env(nodes=("host-a",))
+        cluster.create_pod(shared_pod("solo", request="0.5", limit="1.0"))
+        engine.run_until_idle()
+        env = cluster.get_pod("default", "solo").containers[0].env
+        assert ENV_GANG_NAME not in env
+
+
+class TestDistributedSpec:
+    def test_spec_from_env(self):
+        from kubeshare_tpu.parallel.distributed import spec_from_env
+
+        spec = spec_from_env({
+            "TPUSHARE_GANG_NAME": "ddp", "TPUSHARE_GANG_SIZE": "4",
+            "TPUSHARE_GANG_RANK": "2", "TPUSHARE_COORDINATOR": "10.0.0.5",
+        })
+        assert spec.coordinator_address == "10.0.0.5:8476"
+        assert spec.num_processes == 4 and spec.process_id == 2
+        # headless-service convention when no coordinator given
+        spec = spec_from_env({
+            "TPUSHARE_GANG_NAME": "ddp", "TPUSHARE_GANG_SIZE": "2",
+            "TPUSHARE_GANG_RANK": "0",
+        })
+        assert spec.coordinator_address == "ddp-0.ddp:8476"
+        # solo / malformed -> None
+        assert spec_from_env({}) is None
+        assert spec_from_env({"TPUSHARE_GANG_SIZE": "1",
+                              "TPUSHARE_GANG_RANK": "0"}) is None
+        assert spec_from_env({"TPUSHARE_GANG_SIZE": "4",
+                              "TPUSHARE_GANG_RANK": "9"}) is None
