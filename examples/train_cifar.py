@@ -1,0 +1,62 @@
+"""Gang-member CIFAR ResNet training pod (examples/cifar10-gang-job.yaml)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from kubeshare_tpu.isolation.guard import apply_hbm_cap
+
+apply_hbm_cap()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from kubeshare_tpu.isolation import ExecutionGuard  # noqa: E402
+from kubeshare_tpu.models import ResNetConfig, resnet_apply, resnet_init  # noqa: E402
+from kubeshare_tpu.parallel import make_train_step  # noqa: E402
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=500)
+    parser.add_argument("--batch", type=int, default=128)
+    parser.add_argument("--small", action="store_true",
+                        help="tiny model for CPU smoke runs")
+    args = parser.parse_args()
+
+    guard = ExecutionGuard()
+    config = (ResNetConfig(widths=(8, 16), blocks_per_stage=(1, 1))
+              if args.small else ResNetConfig())
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.standard_normal((4096, 32, 32, 3), dtype=np.float32))
+    labels = jnp.asarray(rng.integers(0, 10, (4096,), dtype=np.int32))
+
+    init_state, train_step = make_train_step(
+        lambda p, x: resnet_apply(p, x, config)
+    )
+    state = init_state(resnet_init(jax.random.PRNGKey(0), config))
+    start = time.monotonic()
+    for step_idx in range(args.steps):
+        i = (step_idx * args.batch) % (images.shape[0] - args.batch)
+        batch = jax.lax.dynamic_slice_in_dim(images, i, args.batch)
+        targets = jax.lax.dynamic_slice_in_dim(labels, i, args.batch)
+        guard.acquire()
+        t0 = time.monotonic()
+        state, loss = train_step(state, batch, targets)
+        jax.block_until_ready(loss)
+        guard.charge((time.monotonic() - t0) * 1e3)
+        if (step_idx + 1) % 50 == 0:
+            rate = (step_idx + 1) / (time.monotonic() - start)
+            print(f"step {step_idx + 1} loss {float(loss):.4f} "
+                  f"{rate:.1f} steps/s", flush=True)
+    guard.finish()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""Fractional-share MNIST training pod (examples/mnist-fractional.yaml).
+
+Runs exactly as the scheduler launches it: picks up the injected env
+(HBM cap before jax init, token broker for compute gating) and trains.
+Ungated when run outside the framework — the same script works both ways.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from kubeshare_tpu.isolation.guard import apply_hbm_cap
+
+apply_hbm_cap()  # must precede jax backend init
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from kubeshare_tpu.isolation import ExecutionGuard  # noqa: E402
+from kubeshare_tpu.models import mnist_apply, mnist_init  # noqa: E402
+from kubeshare_tpu.parallel import make_train_step  # noqa: E402
+from kubeshare_tpu.parallel.checkpoint import (  # noqa: E402
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+
+def synthetic_dataset(n=8192, seed=0):
+    rng = np.random.default_rng(seed)
+    images = rng.standard_normal((n, 28, 28, 1), dtype=np.float32)
+    labels = rng.integers(0, 10, (n,), dtype=np.int32)
+    return jnp.asarray(images), jnp.asarray(labels)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=1000)
+    parser.add_argument("--batch", type=int, default=256)
+    parser.add_argument("--checkpoint-dir", default=os.environ.get("CKPT_DIR", ""))
+    parser.add_argument("--checkpoint-every", type=int, default=200)
+    args = parser.parse_args()
+
+    guard = ExecutionGuard()  # env-configured; passthrough when unmanaged
+    images, labels = synthetic_dataset()
+    init_state, train_step = make_train_step(mnist_apply)
+    state = init_state(mnist_init(jax.random.PRNGKey(0)))
+
+    if args.checkpoint_dir and latest_checkpoint(args.checkpoint_dir):
+        state = restore_checkpoint(args.checkpoint_dir)
+        print(f"resumed from step {int(state.step)}", flush=True)
+
+    start = time.monotonic()
+    done = 0
+    while int(state.step) < args.steps:
+        i = (int(state.step) * args.batch) % (images.shape[0] - args.batch)
+        batch_images = jax.lax.dynamic_slice_in_dim(images, i, args.batch)
+        batch_labels = jax.lax.dynamic_slice_in_dim(labels, i, args.batch)
+        guard.acquire()
+        step_start = time.monotonic()
+        state, loss = train_step(state, batch_images, batch_labels)
+        jax.block_until_ready(loss)
+        guard.charge((time.monotonic() - step_start) * 1e3)
+        done += 1
+        if args.checkpoint_dir and int(state.step) % args.checkpoint_every == 0:
+            save_checkpoint(args.checkpoint_dir, state, int(state.step))
+        if done % 100 == 0:
+            rate = done / (time.monotonic() - start)
+            print(f"step {int(state.step)} loss {float(loss):.4f} "
+                  f"{rate:.1f} steps/s gated={guard.gated}", flush=True)
+    guard.finish()
+    print(f"done: {int(state.step)} steps, final loss {float(loss):.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
