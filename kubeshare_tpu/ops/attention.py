@@ -33,28 +33,70 @@ def attention_reference(
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
 
 
-def _attention_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool, block_q: int):
-    # q block: [block_q, d]; full k/v for this (batch, head): [s, d]
+def _attention_kernel(
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    *, causal: bool, block_q: int, block_k: int, n_kblocks: int,
+):
+    """Flash-attention forward tile: online softmax over K blocks.
+
+    Grid is (b, h, q_blocks, k_blocks) with the K axis innermost — TPU grids
+    run sequentially over the trailing dimension, so the VMEM scratch
+    accumulators (acc/m/l) carry across the K sweep of each Q block.
+    """
     import jax.experimental.pallas as pl  # local import: TPU-only dependency
 
-    q = q_ref[0, 0].astype(jnp.float32)
-    k = k_ref[0, 0].astype(jnp.float32)
-    v = v_ref[0, 0].astype(jnp.float32)
-    scale = q.shape[-1] ** -0.5
-    scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    q_idx = pl.program_id(2)
+    k_idx = pl.program_id(3)
+
+    @pl.when(k_idx == 0)
+    def init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # causal: K blocks entirely above the diagonal contribute nothing — skip
+    # their compute outright (roughly halves causal FLOPs)
+    relevant = True
     if causal:
-        block_idx = pl.program_id(2)
-        q_pos = block_idx * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, 0
+        relevant = k_idx * block_k <= (q_idx + 1) * block_q - 1
+
+    @pl.when(relevant)
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        scale = q.shape[-1] ** -0.5
+        scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, 0
+            )
+            k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, 1
+            )
+            scores = jnp.where(q_pos >= k_pos, scores, -jnp.inf)
+
+        m_prev = m_ref[...]
+        block_max = jnp.max(scores, axis=-1)
+        m_next = jnp.maximum(m_prev, block_max)
+        # fully-masked rows (diagonal blocks' upper rows) keep m = -inf
+        safe_m = jnp.where(jnp.isfinite(m_next), m_next, 0.0)
+        probs = jnp.exp(scores - safe_m[:, None])
+        probs = jnp.where(jnp.isfinite(scores), probs, 0.0)
+        correction = jnp.where(
+            jnp.isfinite(m_prev), jnp.exp(m_prev - safe_m), 0.0
         )
-        k_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
-        scores = jnp.where(q_pos >= k_pos, scores, jnp.finfo(jnp.float32).min)
-    scores -= jnp.max(scores, axis=-1, keepdims=True)
-    probs = jnp.exp(scores)
-    probs /= jnp.sum(probs, axis=-1, keepdims=True)
-    o_ref[0, 0] = jnp.dot(probs, v, preferred_element_type=jnp.float32).astype(
-        o_ref.dtype
-    )
+        l_ref[...] = l_ref[...] * correction + jnp.sum(probs, axis=-1)
+        acc_ref[...] = acc_ref[...] * correction[:, None] + jnp.dot(
+            probs, v, preferred_element_type=jnp.float32
+        )
+        m_ref[...] = m_next
+
+    @pl.when(k_idx == n_kblocks - 1)
+    def finalize():
+        denom = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / denom[:, None]).astype(o_ref.dtype)
 
 
 def _flash_forward(
@@ -64,28 +106,43 @@ def _flash_forward(
     causal: bool,
     block_q: int,
     interpret: bool,
+    block_k: int = 1024,
 ) -> jax.Array:
     import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     b, h, s, d = q.shape
     block_q = min(block_q, s)
-    if s % block_q != 0:
+    block_k = min(block_k, s)
+    if s % block_q != 0 or s % block_k != 0:
         # static shapes only under jit: fall back rather than pad dynamically
         return attention_reference(q, k, v, causal)
-    grid = (b, h, s // block_q)
-    kernel = functools.partial(_attention_kernel, causal=causal, block_q=block_q)
+    n_kblocks = s // block_k
+    grid = (b, h, s // block_q, n_kblocks)
+    kernel = functools.partial(
+        _attention_kernel, causal=causal, block_q=block_q,
+        block_k=block_k, n_kblocks=n_kblocks,
+    )
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)
+            (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
         ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+        ],
         interpret=interpret,
     )(q, k, v)
 
@@ -115,18 +172,20 @@ def flash_attention(
     k: jax.Array,
     v: jax.Array,
     causal: bool = True,
-    block_q: int = 128,
+    block_q: int = 512,
     use_pallas: Optional[bool] = None,
     interpret: bool = False,
 ) -> jax.Array:
-    """Attention with the Pallas TPU kernel when available.
+    """Attention with the Pallas TPU kernel when it wins.
 
-    ``use_pallas=None`` auto-selects: kernel on TPU backends, XLA reference
-    elsewhere (CPU tests can force the kernel with ``interpret=True``).
+    ``use_pallas=None`` auto-selects: the kernel on TPU for sequences >= 1024
+    (measured 1.2-1.9x over the XLA reference on v5e, growing with sequence
+    length — docs/perf.md), the XLA reference otherwise (short sequences and
+    non-TPU backends; CPU tests can force the kernel with ``interpret=True``).
     """
     if use_pallas is None:
         platform = jax.devices()[0].platform
-        use_pallas = platform == "tpu" or interpret
+        use_pallas = (platform == "tpu" and q.shape[2] >= 1024) or interpret
     if not use_pallas:
         return attention_reference(q, k, v, causal)
     return _flash_attention(q, k, v, causal, block_q, interpret)

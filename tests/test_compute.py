@@ -266,3 +266,31 @@ class TestDecoding:
             prefill(params, config, long_prompt)
         with pytest.raises(ValueError):
             greedy_decode(params, config, jnp.zeros((1, 30), jnp.int32), 10)
+
+
+class TestFlashKTiling:
+    def test_multiple_k_blocks(self):
+        from kubeshare_tpu.ops.attention import _flash_forward
+
+        q, k, v = (rand(i, 1, 2, 64, 8) for i in range(3))
+        for causal in (True, False):
+            ref = attention_reference(q, k, v, causal)
+            out = _flash_forward(q, k, v, causal, block_q=16,
+                                 interpret=True, block_k=16)
+            np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_k_tiling_gradients(self):
+        q, k, v = (rand(i, 1, 1, 32, 8) for i in range(3))
+
+        def loss(q, k, v):
+            return flash_attention(q, k, v, block_q=8, use_pallas=True,
+                                   interpret=True).sum()
+
+        g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(
+            lambda q, k, v: attention_reference(q, k, v).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
