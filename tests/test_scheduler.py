@@ -499,3 +499,51 @@ class TestDistributedSpec:
                               "TPUSHARE_GANG_RANK": "0"}) is None
         assert spec_from_env({"TPUSHARE_GANG_SIZE": "4",
                               "TPUSHARE_GANG_RANK": "9"}) is None
+
+
+class TestReferenceScenarioMatrix:
+    """The reference's hand-applied test/*.yaml label permutations
+    (SURVEY §2.12), table-driven.  Each row: (labels, expected outcome)
+    where outcome is 'bound' for valid specs or 'unschedulable' for
+    user errors; citations are the originating reference YAML."""
+
+    SCENARIOS = [
+        # test/pod1.yaml: whole-chip 2.0/2.0 -> valid multi-chip
+        ({"r": "2.0", "l": "2.0"}, "bound"),
+        # test/pod3.yaml: 1.0/1.0 fractional boundary -> valid
+        ({"r": "1.0", "l": "1.0"}, "bound"),
+        # test/pod4.yaml: 0.3/1.0 -> valid fractional
+        ({"r": "0.3", "l": "1.0"}, "bound"),
+        # test/pod5.yaml + mnist1.yaml: + mem + priority 100 -> valid
+        ({"r": "0.3", "l": "1.0", "mem": "3073741824", "prio": "100"}, "bound"),
+        # test/pod6.yaml: integer-form "2"/"2" -> valid
+        ({"r": "2", "l": "2"}, "bound"),
+        # test/pod7.yaml: request 2 limit 2.5 -> invalid (multi-chip
+        # requires limit == request; 2.5 also fails the value format)
+        ({"r": "2", "l": "2.5"}, "unschedulable"),
+        # test/pod8.yaml: request 0.5 > limit 0.3 -> invalid
+        ({"r": "0.5", "l": "0.3"}, "unschedulable"),
+        # test/pod10.yaml: model selector for a nonexistent model
+        ({"r": "0.3", "l": "1.0", "model": "test"}, "unschedulable"),
+        # test/OpportunisticPod/pod11.yaml: priority unset -> opportunistic
+        ({"r": "0.2", "l": "1.0"}, "bound"),
+    ]
+
+    def test_matrix(self):
+        for i, (spec, expected) in enumerate(self.SCENARIOS):
+            cluster, plugin, engine, _ = make_env()
+            labels = {constants.POD_GPU_REQUEST: spec["r"],
+                      constants.POD_GPU_LIMIT: spec["l"]}
+            if "mem" in spec:
+                labels[constants.POD_GPU_MEMORY] = spec["mem"]
+            if "prio" in spec:
+                labels[constants.POD_PRIORITY] = spec["prio"]
+            if "model" in spec:
+                labels[constants.POD_GPU_MODEL] = spec["model"]
+            cluster.create_pod(Pod(name=f"scenario-{i}", labels=labels,
+                                   scheduler_name=constants.SCHEDULER_NAME))
+            results = engine.run_until_idle()
+            outcome = results[-1].result if results else "none"
+            assert outcome == expected, (
+                f"scenario {i} {spec}: expected {expected}, got {outcome}"
+            )
