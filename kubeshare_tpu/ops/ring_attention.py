@@ -67,14 +67,14 @@ def ring_attention(
         return new_m, l, acc
 
     def step(t, carry):
-        # rotate first (t >= 1), then accumulate — the local block (t=0) is
-        # handled outside the loop, so exactly axis_size-1 rotations run and
-        # no final rotation is wasted
+        # kick the next rotation off BEFORE computing on the current block:
+        # the ppermute (ICI neighbor transfer) then overlaps the block's
+        # attention math under XLA's async collectives
         k_cur, v_cur, m, l, acc = carry
-        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
         m, l, acc = accumulate(t, k_cur, v_cur, m, l, acc)
-        return k_cur, v_cur, m, l, acc
+        return k_next, v_next, m, l, acc
 
     # derive the accumulators from q so they carry the same shard_map
     # varying-axes type as the loop outputs (a literal zeros() is
@@ -82,10 +82,12 @@ def ring_attention(
     acc0 = (q * 0).astype(jnp.float32)
     l0 = acc0[..., 0]
     m0 = l0 - jnp.inf
-    m0, l0, acc0 = accumulate(0, k, v, m0, l0, acc0)
-    _, _, _, l, acc = jax.lax.fori_loop(
-        1, axis_size, step, (k, v, m0, l0, acc0)
+    # blocks 0..axis_size-2 in the loop (each issuing one rotation), the
+    # final received block outside — exactly axis_size-1 rotations total
+    k_last, v_last, m_last, l_last, acc_last = jax.lax.fori_loop(
+        0, axis_size - 1, step, (k, v, m0, l0, acc0)
     )
+    _, l, acc = accumulate(axis_size - 1, k_last, v_last, m_last, l_last, acc_last)
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return out.astype(q.dtype)
 
