@@ -547,3 +547,38 @@ class TestReferenceScenarioMatrix:
             assert outcome == expected, (
                 f"scenario {i} {spec}: expected {expected}, got {outcome}"
             )
+
+
+class TestLabelParserFuzz:
+    def test_parser_never_crashes(self):
+        """Any label garbage must yield a clean outcome: regular pod, a
+        PodLabelError, or a parsed status — never an unhandled exception."""
+        import random
+
+        rng = random.Random(0)
+        tokens = ["0.5", "1.0", "2", "2.0", "-1", "abc", "", "0x5", "1e3",
+                  "999999999999999999999", "0.0000001", " 1.0", "1.0 ",
+                  "nan", "inf", "-0.5", "1,0", "½", "2.5", "01.0", "100"]
+        label_names = [constants.POD_GPU_LIMIT, constants.POD_GPU_REQUEST,
+                       constants.POD_GPU_MEMORY, constants.POD_PRIORITY,
+                       constants.POD_GROUP_NAME, constants.POD_GROUP_HEADCOUNT,
+                       constants.POD_GROUP_THRESHOLD, constants.POD_GPU_MODEL]
+        outcomes = {"regular": 0, "error": 0, "parsed": 0}
+        for i in range(500):
+            labels = {}
+            for name in label_names:
+                if rng.random() < 0.5:
+                    labels[name] = rng.choice(tokens)
+            pod = Pod(name=f"fuzz-{i}", labels=labels,
+                      scheduler_name=constants.SCHEDULER_NAME)
+            try:
+                status = parse_pod_labels(pod)
+                outcomes["parsed" if status else "regular"] += 1
+                if status:
+                    assert status.limit >= 0 and status.request >= 0
+                    assert status.request <= status.limit
+                    assert status.memory >= 0
+            except PodLabelError:
+                outcomes["error"] += 1
+        # all three outcome classes must occur across the corpus
+        assert all(v > 0 for v in outcomes.values()), outcomes
