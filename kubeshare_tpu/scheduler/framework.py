@@ -89,6 +89,7 @@ class SchedulerEngine:
     def run_once(self) -> Optional[CycleStatus]:
         """Schedule the head-of-queue pod through one full cycle."""
         self.expire_waiting_pods()
+        self.plugin.pod_groups.gc()  # ref pod_group.go:119-129 (30s loop)
         pending = self.pending_pods()
         if not pending:
             return None
