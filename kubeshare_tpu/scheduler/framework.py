@@ -101,6 +101,7 @@ class SchedulerEngine:
         stuck: Dict[str, int] = {}
         for _ in range(max_cycles):
             self.expire_waiting_pods()
+            self.plugin.pod_groups.gc()
             pending = [
                 p for p in self.pending_pods() if stuck.get(p.key, 0) < 2
             ]

@@ -695,7 +695,10 @@ class KubeShareScheduler:
                 1 for p in pods if p.phase != PodPhase.FAILED and p.key != pod.key
             )
             if remaining <= 0:
-                self.pod_groups.remove(key)
+                # mark-then-expire (ref pod_group.go:119-129): a gang
+                # recreated within the expiration window re-activates with
+                # its original timestamp, keeping its queue seniority
+                self.pod_groups.mark_deleted(key)
 
     def process_bound_pod_queue(self, node_name: str) -> None:
         """Scheduler-restart recovery: re-reserve resources for pods that

@@ -424,15 +424,31 @@ class TestReviewRegressions:
         assert engine.run_until_idle()[-1].result == "bound"
 
     def test_failed_gang_member_keeps_group(self):
-        cluster, plugin, engine, _ = make_env(nodes=("host-a",))
+        cluster, plugin, engine, clock = make_env(nodes=("host-a",))
         for i in range(2):
             cluster.create_pod(shared_pod(f"g{i}", request="0.2", limit="1.0",
                                           group="gg", headcount=2, threshold=0.5))
         engine.run_until_idle()
         cluster.set_pod_phase("default", "g0", PodPhase.FAILED)
-        assert plugin.pod_groups.get("default/gg") is not None
+        info = plugin.pod_groups.get("default/gg")
+        assert info is not None and info.deletion_timestamp is None
+        original_ts = info.timestamp
         cluster.delete_pod("default", "g1")
         cluster.delete_pod("default", "g0")
+        # mark-then-expire: marked deleted, not yet collected
+        marked = plugin.pod_groups.get("default/gg")
+        assert marked is not None and marked.deletion_timestamp is not None
+        # quick recreation re-activates with the ORIGINAL timestamp
+        cluster.create_pod(shared_pod("g-new", request="0.2", limit="1.0",
+                                      group="gg", headcount=2, threshold=0.5))
+        engine.run_until_idle()
+        revived = plugin.pod_groups.get("default/gg")
+        assert revived.deletion_timestamp is None
+        assert revived.timestamp == original_ts
+        # after teardown + expiration, GC collects
+        cluster.delete_pod("default", "g-new")
+        clock.advance(constants.POD_GROUP_EXPIRATION_TIME_SECONDS + 1)
+        plugin.pod_groups.gc()
         assert plugin.pod_groups.get("default/gg") is None
 
     def test_shadow_mode_keeps_reservation(self):
