@@ -1,11 +1,12 @@
 """Attention ops: XLA reference + Pallas TPU kernel.
 
-The compute path is designed MXU-first (SURVEY-prompt constraints): large
-batched matmuls, bf16-friendly, static shapes.  ``flash_attention`` runs a
-Pallas kernel that streams query blocks through VMEM (never materializing
-the full S x S score matrix in HBM); gradients recompute through the XLA
-reference implementation via custom_vjp — XLA fuses that path well, and the
-kernel keeps the forward/serving path HBM-lean.
+The compute path is designed MXU-first: large batched matmuls,
+bf16-friendly, static shapes.  ``flash_attention`` runs Pallas kernels for
+both directions — a K-tiled online-softmax forward that saves per-row
+logsumexp, and a two-sweep backward (dk/dv over Q blocks, dq over K blocks)
+that recomputes block probabilities from it — so nothing S x S ever
+materializes in HBM.  Shapes that don't tile the blocks fall back to the
+XLA reference in both directions.
 
 Shapes: q, k, v are [batch, heads, seq, head_dim].
 """
@@ -34,7 +35,7 @@ def attention_reference(
 
 
 def _attention_kernel(
-    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     *, causal: bool, block_q: int, block_k: int, n_kblocks: int,
 ):
     """Flash-attention forward tile: online softmax over K blocks.
@@ -97,6 +98,10 @@ def _attention_kernel(
     def finalize():
         denom = jnp.maximum(l_ref[...], 1e-30)
         o_ref[0, 0] = (acc_ref[...] / denom[:, None]).astype(o_ref.dtype)
+        # logsumexp residual for the backward kernels
+        lse_ref[0, 0, :, 0] = jnp.where(
+            l_ref[...] > 0, m_ref[...] + jnp.log(denom), -jnp.inf
+        )
 
 
 def _flash_forward(
@@ -107,7 +112,9 @@ def _flash_forward(
     block_q: int,
     interpret: bool,
     block_k: int = 1024,
-) -> jax.Array:
+):
+    """Returns (out, lse) from the Pallas kernel, or (out, None) when the
+    shape falls back to the XLA reference."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -116,16 +123,19 @@ def _flash_forward(
     block_k = min(block_k, s)
     if s % block_q != 0 or s % block_k != 0:
         # static shapes only under jit: fall back rather than pad dynamically
-        return attention_reference(q, k, v, causal)
+        return attention_reference(q, k, v, causal), None
     n_kblocks = s // block_k
     grid = (b, h, s // block_q, n_kblocks)
     kernel = functools.partial(
         _attention_kernel, causal=causal, block_q=block_q,
         block_k=block_k, n_kblocks=n_kblocks,
     )
-    return pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d),
@@ -135,8 +145,11 @@ def _flash_forward(
             pl.BlockSpec((1, 1, block_k, d),
                          lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+        out_specs=(
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         ),
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -145,23 +158,224 @@ def _flash_forward(
         ],
         interpret=interpret,
     )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward kernels: block-recomputed probabilities from the saved logsumexp
+# (the standard flash-attention backward; nothing S x S ever materializes)
+# ---------------------------------------------------------------------------
+
+
+def _recompute_probs(q, k, lse, q_idx, k_idx, causal, block_q, block_k):
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 0
+        )
+        k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1
+        )
+        scores = jnp.where(q_pos >= k_pos, scores, -jnp.inf)
+    probs = jnp.exp(scores - lse[:, None])
+    return jnp.where(jnp.isfinite(scores), probs, 0.0)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, causal, block_q, block_k, n_qblocks,
+):
+    """Sweep over Q blocks (innermost grid axis) accumulating dk, dv for one
+    K block."""
+    import jax.experimental.pallas as pl
+
+    k_idx = pl.program_id(2)
+    q_idx = pl.program_id(3)
+
+    @pl.when(q_idx == 0)
+    def init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    relevant = True
+    if causal:
+        relevant = k_idx * block_k <= (q_idx + 1) * block_q - 1
+
+    @pl.when(relevant)
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
+        scale = q.shape[-1] ** -0.5
+        probs = _recompute_probs(q, k, lse, q_idx, k_idx, causal,
+                                 block_q, block_k)
+        dv_acc[...] += jnp.dot(probs.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = probs * (dp - delta[:, None])
+        dk_acc[...] += scale * jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(q_idx == n_qblocks - 1)
+    def finalize():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    dq_acc, *, causal, block_q, block_k, n_kblocks,
+):
+    """Sweep over K blocks (innermost grid axis) accumulating dq for one Q
+    block."""
+    import jax.experimental.pallas as pl
+
+    q_idx = pl.program_id(2)
+    k_idx = pl.program_id(3)
+
+    @pl.when(k_idx == 0)
+    def init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    relevant = True
+    if causal:
+        relevant = k_idx * block_k <= (q_idx + 1) * block_q - 1
+
+    @pl.when(relevant)
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
+        scale = q.shape[-1] ** -0.5
+        probs = _recompute_probs(q, k, lse, q_idx, k_idx, causal,
+                                 block_q, block_k)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = probs * (dp - delta[:, None])
+        dq_acc[...] += scale * jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(k_idx == n_kblocks - 1)
+    def finalize():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _flash_backward(
+    q, k, v, out, lse, g, causal, interpret,
+    block_q: int = 256, block_k: int = 512,
+):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, s, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    n_qblocks = s // block_q
+    n_kblocks = s // block_k
+
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
+                keepdims=True)
+
+    qd_spec = pl.BlockSpec((1, 1, block_q, d),
+                           lambda bi, hi, xi, yi: (bi, hi, xi, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q, 1),
+                           lambda bi, hi, xi, yi: (bi, hi, xi, 0))
+
+    # dk/dv: grid (b, h, kb, qb) — q sweeps innermost
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, causal=causal, block_q=block_q,
+            block_k=block_k, n_qblocks=n_qblocks,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ),
+        grid=(b, h, n_kblocks, n_qblocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),  # q
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),  # k
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),  # v
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),  # dO
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),  # lse
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),  # delta
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    # dq: grid (b, h, qb, kb) — k sweeps innermost
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, causal=causal, block_q=block_q,
+            block_k=block_k, n_kblocks=n_kblocks,
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=(b, h, n_qblocks, n_kblocks),
+        in_specs=[
+            qd_spec,  # q
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),  # k
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),  # v
+            qd_spec,  # dO
+            row_spec,  # lse
+            row_spec,  # delta
+        ],
+        out_specs=qd_spec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash_attention(q, k, v, causal, block_q, interpret):
-    return _flash_forward(q, k, v, causal, block_q, interpret)
+    out, _ = _flash_forward(q, k, v, causal, block_q, interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, block_q, interpret):
-    out = _flash_forward(q, k, v, causal, block_q, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, block_q, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, interpret, residuals, g):
-    q, k, v = residuals
-    # rematerialized backward through the XLA reference path
-    _, vjp = jax.vjp(lambda q, k, v: attention_reference(q, k, v, causal), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    s = q.shape[2]
+    bwd_bq = min(256, s)
+    bwd_bk = min(512, s)
+    if lse is None or s % bwd_bq != 0 or s % bwd_bk != 0:
+        # forward fell back, or seq doesn't tile the backward blocks (its
+        # defaults differ from the forward's): use the XLA reference vjp —
+        # a silent partial grid would drop trailing rows
+        _, vjp = jax.vjp(
+            lambda q, k, v: attention_reference(q, k, v, causal), q, k, v
+        )
+        return vjp(g)
+    return _flash_backward(q, k, v, out, lse, g, causal, interpret,
+                           block_q=bwd_bq, block_k=bwd_bk)
 
 
 _flash_attention.defvjp(_flash_fwd, _flash_bwd)
