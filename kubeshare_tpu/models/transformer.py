@@ -34,6 +34,7 @@ class TransformerConfig:
     max_seq_len: int = 2048
     dtype: jnp.dtype = jnp.bfloat16
     attention: str = "auto"  # auto | reference | flash | ring
+    attention_window: Optional[int] = None  # sliding-window (local) size
 
     @property
     def head_dim(self) -> int:
@@ -83,11 +84,14 @@ def _rms_norm(x, scale):
 
 def _select_attention(config: TransformerConfig):
     kind = config.attention
+    window = config.attention_window
     if kind == "auto":
         kind = "flash" if jax.devices()[0].platform == "tpu" else "reference"
     if kind == "flash":
-        return lambda q, k, v: flash_attention(q, k, v, causal=True)
-    return lambda q, k, v: attention_reference(q, k, v, causal=True)
+        return lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                               window=window)
+    return lambda q, k, v: attention_reference(q, k, v, causal=True,
+                                               window=window)
 
 
 def _forward(params, tokens, config, attention_fn, pos_offset):
@@ -144,6 +148,13 @@ def transformer_apply_ring(
 ) -> jax.Array:
     """Sequence-parallel forward: tokens sharded over ``seq_axis``, ring
     attention carrying K/V around the ICI ring (long-context path)."""
+
+    if config.attention_window is not None:
+        raise ValueError(
+            "attention_window is not supported on the ring path yet; use "
+            "attention='flash' (windowed attention is local by nature and "
+            "rarely needs sequence parallelism)"
+        )
 
     def local_forward(params, tokens):
         local_seq = tokens.shape[1]

@@ -348,3 +348,75 @@ class TestFlashBackwardFallback:
             assert np.isfinite(np.asarray(a)).all()
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-4)
+
+
+class TestSlidingWindowAttention:
+    def test_window_matches_reference(self):
+        q, k, v = (rand(i, 1, 2, 64, 8) for i in range(3))
+        for window in (8, 16, 64):
+            ref = attention_reference(q, k, v, causal=True, window=window)
+            out = flash_attention(q, k, v, block_q=16, use_pallas=True,
+                                  interpret=True, window=window)
+            np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_window_gradients(self):
+        q, k, v = (rand(i, 1, 1, 32, 8) for i in range(3))
+
+        def loss(q, k, v):
+            return flash_attention(q, k, v, block_q=8, use_pallas=True,
+                                   interpret=True, window=8).sum()
+
+        def loss_ref(q, k, v):
+            return attention_reference(q, k, v, True, window=8).sum()
+
+        g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_window_equals_full_causal(self):
+        # window >= seq is exactly causal attention
+        q, k, v = (rand(i, 1, 1, 32, 8) for i in range(3))
+        full = attention_reference(q, k, v, causal=True)
+        windowed = flash_attention(q, k, v, block_q=8, use_pallas=True,
+                                   interpret=True, window=32)
+        np.testing.assert_allclose(np.asarray(full), np.asarray(windowed),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_window_with_multiple_k_blocks(self):
+        # force several K blocks so the band-skip clause actually runs
+        from kubeshare_tpu.ops.attention import _flash_forward
+
+        q, k, v = (rand(i, 1, 2, 64, 8) for i in range(3))
+        for window in (8, 24, 40):
+            ref = attention_reference(q, k, v, causal=True, window=window)
+            out, _ = _flash_forward(q, k, v, True, 16, True, block_k=16,
+                                    window=window)
+            np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_window_backward_multiple_blocks(self):
+        # s=1024 -> bwd blocks 256/512: several blocks in both sweeps
+        q, k, v = (rand(i, 1, 1, 1024, 8) for i in range(3))
+
+        def loss(q, k, v):
+            return flash_attention(q, k, v, use_pallas=True, interpret=True,
+                                   window=300).sum()
+
+        def loss_ref(q, k, v):
+            return attention_reference(q, k, v, True, window=300).sum()
+
+        g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-3)
+
+    def test_invalid_window_rejected(self):
+        q = rand(0, 1, 1, 16, 8)
+        with pytest.raises(ValueError):
+            flash_attention(q, q, q, window=0)
+        with pytest.raises(ValueError):
+            attention_reference(q, q, q, window=-5)
