@@ -13,6 +13,7 @@ from typing import Dict, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..ops.rope import apply_rope
 from .transformer import TransformerConfig, _rms_norm
 
 
@@ -43,8 +44,10 @@ def _decode_one(params, config: TransformerConfig, cache: Dict, token: jax.Array
     dtype = config.dtype
     position = cache["length"]
     x = params["embed"][token].astype(dtype)[:, None, :]  # [b,1,d]
-    pos_embed = jax.lax.dynamic_slice_in_dim(params["pos_embed"], position, 1)
-    x = x + pos_embed.astype(dtype)
+    use_rope = config.positional == "rope"
+    if not use_rope:
+        pos_embed = jax.lax.dynamic_slice_in_dim(params["pos_embed"], position, 1)
+        x = x + pos_embed.astype(dtype)
 
     new_k, new_v = [], []
     for layer_idx, layer in enumerate(params["layers"]):
@@ -52,6 +55,10 @@ def _decode_one(params, config: TransformerConfig, cache: Dict, token: jax.Array
         q = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wq"].astype(dtype))
         k = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wk"].astype(dtype))
         v = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wv"].astype(dtype))
+        if use_rope:
+            pos = position[None] if position.ndim == 0 else position
+            q = apply_rope(q, pos)
+            k = apply_rope(k, pos)
         cache_k = jax.lax.dynamic_update_slice_in_dim(
             cache["k"][layer_idx], k, position, axis=2
         )

@@ -22,6 +22,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import attention_reference, flash_attention
 from ..ops.ring_attention import ring_attention
+from ..ops.rope import apply_rope, rope_positions
 
 
 @dataclass(frozen=True)
@@ -35,6 +36,7 @@ class TransformerConfig:
     dtype: jnp.dtype = jnp.bfloat16
     attention: str = "auto"  # auto | reference | flash | ring
     attention_window: Optional[int] = None  # sliding-window (local) size
+    positional: str = "learned"  # learned | rope
 
     @property
     def head_dim(self) -> int:
@@ -99,8 +101,12 @@ def _forward(params, tokens, config, attention_fn, pos_offset):
     dtype = config.dtype
     seq = tokens.shape[1]
     x = params["embed"][tokens].astype(dtype)
-    pos = jax.lax.dynamic_slice_in_dim(params["pos_embed"], pos_offset, seq)
-    x = x + pos.astype(dtype)
+    use_rope = config.positional == "rope"
+    if use_rope:
+        positions = rope_positions(seq, pos_offset)
+    else:
+        pos = jax.lax.dynamic_slice_in_dim(params["pos_embed"], pos_offset, seq)
+        x = x + pos.astype(dtype)
 
     for layer in params["layers"]:
         # attention block
@@ -108,6 +114,9 @@ def _forward(params, tokens, config, attention_fn, pos_offset):
         q = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wq"].astype(dtype))
         k = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wk"].astype(dtype))
         v = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wv"].astype(dtype))
+        if use_rope:
+            q = apply_rope(q, positions)
+            k = apply_rope(k, positions)
         o = attention_fn(q, k, v).astype(dtype)
         x = x + jnp.einsum("bhsk,hkd->bsd", o, layer["attn"]["wo"].astype(dtype))
         # mlp block

@@ -31,6 +31,11 @@ def attention_reference(
     """
     if window is not None and window <= 0:
         raise ValueError(f"window must be positive, got {window}")
+    if k.shape[1] != q.shape[1]:
+        # grouped-query attention: repeat each KV head over its query group
+        group = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal or window is not None:
@@ -150,6 +155,10 @@ def _flash_forward(
     from jax.experimental.pallas import tpu as pltpu
 
     b, h, s, d = q.shape
+    h_kv = k.shape[1]
+    if h % h_kv != 0:
+        raise ValueError(f"query heads {h} not a multiple of kv heads {h_kv}")
+    group = h // h_kv
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     if s % block_q != 0 or s % block_k != 0:
@@ -172,9 +181,9 @@ def _flash_forward(
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+                         lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+                         lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
         ],
         out_specs=(
             pl.BlockSpec((1, 1, block_q, d),
@@ -295,6 +304,20 @@ def _flash_backward(
     from jax.experimental.pallas import tpu as pltpu
 
     b, h, s, d = q.shape
+    h_kv = k.shape[1]
+    group = h // h_kv
+    if group > 1:
+        # GQA: run the backward at full query-head resolution, then reduce
+        # the kv grads over each group (cheap XLA sum vs kernel revisits)
+        k_full = jnp.repeat(k, group, axis=1)
+        v_full = jnp.repeat(v, group, axis=1)
+        dq, dk_full, dv_full = _flash_backward(
+            q, k_full, v_full, out, lse, g, causal, interpret,
+            block_q=block_q, block_k=block_k, window=window,
+        )
+        dk = dk_full.reshape(b, h_kv, group, s, d).sum(axis=2).astype(k.dtype)
+        dv = dv_full.reshape(b, h_kv, group, s, d).sum(axis=2).astype(v.dtype)
+        return dq, dk, dv
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     n_qblocks = s // block_q

@@ -420,3 +420,108 @@ class TestSlidingWindowAttention:
             flash_attention(q, q, q, window=0)
         with pytest.raises(ValueError):
             attention_reference(q, q, q, window=-5)
+
+
+class TestGQA:
+    def test_gqa_matches_repeated_reference(self):
+        q = rand(0, 1, 8, 64, 16)
+        k = rand(1, 1, 2, 64, 16)  # 2 kv heads, group of 4
+        v = rand(2, 1, 2, 64, 16)
+        k_full = jnp.repeat(k, 4, axis=1)
+        v_full = jnp.repeat(v, 4, axis=1)
+        ref = attention_reference(q, k_full, v_full, causal=True)
+        out = flash_attention(q, k, v, block_q=16, use_pallas=True,
+                              interpret=True)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_gqa_gradients(self):
+        q = rand(0, 1, 4, 32, 8)
+        k = rand(1, 1, 2, 32, 8)
+        v = rand(2, 1, 2, 32, 8)
+
+        def loss(q, k, v):
+            return flash_attention(q, k, v, block_q=8, use_pallas=True,
+                                   interpret=True).sum()
+
+        def loss_ref(q, k, v):
+            return attention_reference(
+                q, jnp.repeat(k, 2, axis=1), jnp.repeat(v, 2, axis=1)
+            ).sum()
+
+        g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        # reference grads for grouped kv: sum over the repeat
+        gq_ref, gk_full, gv_full = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(np.asarray(g[0]), np.asarray(gq_ref),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(g[1]), np.asarray(gk_full),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(g[2]), np.asarray(gv_full),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_bad_head_ratio_rejected(self):
+        q = rand(0, 1, 6, 16, 8)
+        k = rand(1, 1, 4, 16, 8)
+        with pytest.raises(ValueError):
+            flash_attention(q, k, k, block_q=8, use_pallas=True, interpret=True)
+
+
+class TestRope:
+    def test_rope_shapes_and_rotation_identity(self):
+        from kubeshare_tpu.ops.rope import apply_rope, rope_positions
+
+        x = rand(0, 2, 4, 16, 8)
+        out = apply_rope(x, rope_positions(16))
+        assert out.shape == x.shape
+        # position 0 is the identity rotation
+        np.testing.assert_allclose(np.asarray(out[:, :, 0]),
+                                   np.asarray(x[:, :, 0]), rtol=1e-5)
+        # rotation preserves pair norms
+        def pair_norms(a):
+            a1, a2 = np.split(np.asarray(a, np.float64), 2, axis=-1)
+            return a1**2 + a2**2
+        np.testing.assert_allclose(pair_norms(out), pair_norms(x), rtol=1e-4)
+
+    def test_rope_relative_shift_invariance(self):
+        from kubeshare_tpu.ops.rope import apply_rope, rope_positions
+
+        # attention scores depend only on relative positions
+        q = rand(0, 1, 1, 8, 8)
+        k = rand(1, 1, 1, 8, 8)
+        def scores(offset):
+            pos = rope_positions(8, offset)
+            qr, kr = apply_rope(q, pos), apply_rope(k, pos)
+            return np.asarray(jnp.einsum("bhqd,bhkd->bhqk", qr, kr))
+        np.testing.assert_allclose(scores(0), scores(17), rtol=1e-4, atol=1e-5)
+
+    def test_rope_transformer_and_decode_consistent(self):
+        from kubeshare_tpu.models.decoding import prefill
+
+        config = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq_len=32, dtype=jnp.float32, attention="reference",
+            positional="rope",
+        )
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0, 64)
+        dense = transformer_apply(params, prompt, config)
+        _, last_logits = prefill(params, config, prompt)
+        np.testing.assert_allclose(np.asarray(dense[:, -1]),
+                                   np.asarray(last_logits),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_rope_ring_matches_dense(self):
+        from kubeshare_tpu.models.transformer import transformer_apply_ring
+
+        mesh = make_mesh(MeshSpec(dp=2, tp=1, sp=4))
+        config = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq_len=64, dtype=jnp.float32, attention="reference",
+            positional="rope",
+        )
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 64)
+        dense = transformer_apply(params, tokens, config)
+        ring = transformer_apply_ring(params, tokens, config, mesh)
+        np.testing.assert_allclose(np.asarray(dense), np.asarray(ring),
+                                   rtol=2e-4, atol=2e-4)
