@@ -56,9 +56,8 @@ def _decode_one(params, config: TransformerConfig, cache: Dict, token: jax.Array
         k = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wk"].astype(dtype))
         v = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wv"].astype(dtype))
         if use_rope:
-            pos = position[None] if position.ndim == 0 else position
-            q = apply_rope(q, pos)
-            k = apply_rope(k, pos)
+            q = apply_rope(q, position[None])  # length is always a scalar
+            k = apply_rope(k, position[None])
         cache_k = jax.lax.dynamic_update_slice_in_dim(
             cache["k"][layer_idx], k, position, axis=2
         )

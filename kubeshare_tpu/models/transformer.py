@@ -52,13 +52,20 @@ def transformer_init(rng: jax.Array, config: TransformerConfig) -> Dict:
     def dense(key, shape, fan_in):
         return jax.random.normal(key, shape, jnp.float32) * (1.0 / fan_in) ** 0.5
 
+    if config.positional not in ("learned", "rope"):
+        raise ValueError(
+            f"positional must be 'learned' or 'rope', got {config.positional!r}"
+        )
     params: Dict = {
         "embed": dense(next(keys), (config.vocab_size, d), d),
-        "pos_embed": dense(next(keys), (config.max_seq_len, d), d),
         "layers": [],
         "final_norm": {"scale": jnp.ones((d,))},
         "lm_head": dense(next(keys), (d, config.vocab_size), d),
     }
+    if config.positional == "learned":
+        # rope configs skip the table entirely (at long max_seq_len it would
+        # be dead weight in params, optimizer state, and checkpoints)
+        params["pos_embed"] = dense(next(keys), (config.max_seq_len, d), d)
     for _ in range(config.n_layers):
         params["layers"].append(
             {
@@ -101,6 +108,10 @@ def _forward(params, tokens, config, attention_fn, pos_offset):
     dtype = config.dtype
     seq = tokens.shape[1]
     x = params["embed"][tokens].astype(dtype)
+    if config.positional not in ("learned", "rope"):
+        raise ValueError(
+            f"positional must be 'learned' or 'rope', got {config.positional!r}"
+        )
     use_rope = config.positional == "rope"
     if use_rope:
         positions = rope_positions(seq, pos_offset)

@@ -33,6 +33,10 @@ def attention_reference(
         raise ValueError(f"window must be positive, got {window}")
     if k.shape[1] != q.shape[1]:
         # grouped-query attention: repeat each KV head over its query group
+        if q.shape[1] % k.shape[1] != 0:
+            raise ValueError(
+                f"query heads {q.shape[1]} not a multiple of kv heads {k.shape[1]}"
+            )
         group = q.shape[1] // k.shape[1]
         k = jnp.repeat(k, group, axis=1)
         v = jnp.repeat(v, group, axis=1)
@@ -306,18 +310,6 @@ def _flash_backward(
     b, h, s, d = q.shape
     h_kv = k.shape[1]
     group = h // h_kv
-    if group > 1:
-        # GQA: run the backward at full query-head resolution, then reduce
-        # the kv grads over each group (cheap XLA sum vs kernel revisits)
-        k_full = jnp.repeat(k, group, axis=1)
-        v_full = jnp.repeat(v, group, axis=1)
-        dq, dk_full, dv_full = _flash_backward(
-            q, k_full, v_full, out, lse, g, causal, interpret,
-            block_q=block_q, block_k=block_k, window=window,
-        )
-        dk = dk_full.reshape(b, h_kv, group, s, d).sum(axis=2).astype(k.dtype)
-        dv = dv_full.reshape(b, h_kv, group, s, d).sum(axis=2).astype(v.dtype)
-        return dq, dk, dv
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     n_qblocks = s // block_q
@@ -331,24 +323,26 @@ def _flash_backward(
     row_spec = pl.BlockSpec((1, 1, block_q, 1),
                            lambda bi, hi, xi, yi: (bi, hi, xi, 0))
 
-    # dk/dv: grid (b, h, kb, qb) — q sweeps innermost
-    dk, dv = pl.pallas_call(
+    # dk/dv: grid (b, h, kb, qb) — q sweeps innermost.  GQA: k/v are read
+    # grouped (hi // group index map, no HBM repeat); dk/dv come out at full
+    # query-head resolution and are group-reduced after the call.
+    dk_full, dv_full = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, causal=causal, block_q=block_q,
             block_k=block_k, n_qblocks=n_qblocks, window=window,
         ),
         out_shape=(
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            jax.ShapeDtypeStruct((b, h, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, s, d), v.dtype),
         ),
         grid=(b, h, n_kblocks, n_qblocks),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, ki, qi: (bi, hi, qi, 0)),  # q
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),  # k
+                         lambda bi, hi, ki, qi: (bi, hi // group, ki, 0)),  # k
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),  # v
+                         lambda bi, hi, ki, qi: (bi, hi // group, ki, 0)),  # v
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, ki, qi: (bi, hi, qi, 0)),  # dO
             pl.BlockSpec((1, 1, block_q, 1),
@@ -368,6 +362,11 @@ def _flash_backward(
         ],
         interpret=interpret,
     )(q, k, v, g, lse, delta)
+    if group > 1:
+        dk = dk_full.reshape(b, h_kv, group, s, d).sum(axis=2).astype(k.dtype)
+        dv = dv_full.reshape(b, h_kv, group, s, d).sum(axis=2).astype(v.dtype)
+    else:
+        dk, dv = dk_full, dv_full
 
     # dq: grid (b, h, qb, kb) — k sweeps innermost
     dq = pl.pallas_call(
@@ -380,9 +379,9 @@ def _flash_backward(
         in_specs=[
             qd_spec,  # q
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),  # k
+                         lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),  # k
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),  # v
+                         lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),  # v
             qd_spec,  # dO
             row_spec,  # lse
             row_spec,  # delta

@@ -525,3 +525,18 @@ class TestRope:
         ring = transformer_apply_ring(params, tokens, config, mesh)
         np.testing.assert_allclose(np.asarray(dense), np.asarray(ring),
                                    rtol=2e-4, atol=2e-4)
+
+    def test_rope_config_validation_and_no_pos_table(self):
+        config = TransformerConfig(
+            vocab_size=16, d_model=16, n_heads=2, n_layers=1, d_ff=16,
+            max_seq_len=16, dtype=jnp.float32, attention="reference",
+            positional="rope",
+        )
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        assert "pos_embed" not in params  # no dead table under rope
+        bad = TransformerConfig(
+            vocab_size=16, d_model=16, n_heads=2, n_layers=1, d_ff=16,
+            max_seq_len=16, dtype=jnp.float32, positional="Rotary",
+        )
+        with pytest.raises(ValueError):
+            transformer_init(jax.random.PRNGKey(0), bad)
