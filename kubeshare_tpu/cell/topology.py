@@ -29,6 +29,17 @@ CHIPS_PER_HOST = {
     "TPU-v6e": 8,
 }
 
+# HBM per chip by generation (fallback when the runtime exposes no
+# memory_stats; a 0-byte chip would make every HBM cap default to uncapped)
+DEFAULT_HBM_BYTES = {
+    "TPU-v2": 8 << 30,
+    "TPU-v3": 16 << 30,
+    "TPU-v4": 32 << 30,
+    "TPU-v5e": 16 << 30,
+    "TPU-v5p": 95 << 30,
+    "TPU-v6e": 32 << 30,
+}
+
 # heterogeneity ranking by default: newer generations score higher
 DEFAULT_MODEL_PRIORITY = {
     "TPU-v6e": 100,
@@ -170,6 +181,8 @@ def discover_local_chips(backend: Optional[str] = None) -> List[ChipInfo]:
             memory = int(stats.get("bytes_limit", 0))
         except Exception:
             memory = 0
+        if memory <= 0:
+            memory = DEFAULT_HBM_BYTES.get(model, 0)
         coords = tuple(getattr(device, "coords", ()) or ()) or None
         chips.append(
             ChipInfo(
