@@ -79,6 +79,7 @@ class ExecutionGuard:
         self._lock = threading.RLock()
         self._last_activity = 0.0
         self._idle_release_ms = idle_release_ms
+        self._in_flight = False  # between acquire() and charge(): a step runs
         self._monitor: Optional[threading.Thread] = None
         self.tokens_acquired = 0
         self.total_gated_ms = 0.0
@@ -119,6 +120,7 @@ class ExecutionGuard:
             return 0.0
         with self._lock:
             self._last_activity = time.monotonic()
+            self._in_flight = True  # a step follows; idle monitor backs off
             if self._held and self._budget_ms > 0:
                 return self._budget_ms
             if self._held:
@@ -137,6 +139,7 @@ class ExecutionGuard:
             return
         with self._lock:
             self._last_activity = time.monotonic()
+            self._in_flight = False
             self._estimate_ms = 0.8 * self._estimate_ms + 0.2 * elapsed_ms
             self.total_gated_ms += elapsed_ms
             self._budget_ms -= elapsed_ms
@@ -170,7 +173,10 @@ class ExecutionGuard:
                 time.sleep(self._idle_release_ms / 1e3 / 4)
                 with self._lock:
                     idle_ms = (time.monotonic() - self._last_activity) * 1e3
-                    if self._held and idle_ms >= self._idle_release_ms:
+                    # never release mid-step: a long execution (first-step
+                    # compile!) between acquire and charge is not idleness
+                    if (self._held and not self._in_flight
+                            and idle_ms >= self._idle_release_ms):
                         try:
                             self._release_held()
                         except ConnectionError:

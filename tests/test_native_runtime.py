@@ -452,3 +452,22 @@ class TestIdleRelease:
         t.join(timeout=5)   # idle monitor releases within ~100ms
         assert granted
         a.close(); b.close()
+
+    def test_no_release_while_step_in_flight(self, tokend_exclusive):
+        """A long step (e.g. first-step compile) between acquire and charge
+        must not be treated as idleness."""
+        a = TokenClient("127.0.0.1", tokend_exclusive["port"], "ns/pod-a")
+        guard = ExecutionGuard(client=a, from_env=False, idle_release_ms=80)
+        guard.acquire()  # step begins; no charge yet
+        time.sleep(0.4)  # "compiling"
+        b = TokenClient("127.0.0.1", tokend_exclusive["port"], "ns/pod-b")
+        granted = []
+        t = threading.Thread(target=lambda: (b.acquire(), granted.append(1),
+                                             b.release(1.0)))
+        t.start()
+        time.sleep(0.1)
+        assert not granted  # still held through the in-flight step
+        guard.charge(1.0)  # step ends; budget remains -> held but idle now
+        t.join(timeout=5)  # idle monitor releases
+        assert granted
+        a.close(); b.close()
