@@ -598,3 +598,62 @@ class TestLabelParserFuzz:
                 outcomes["error"] += 1
         # all three outcome classes must occur across the corpus
         assert all(v > 0 for v in outcomes.values()), outcomes
+
+
+class TestScoringFormulas:
+    """Hand-computed checks of the scoring math against the reference
+    formulas (ref score.go:42-68 opportunistic, score.go:85-112 guarantee,
+    scheduler.go:443-487 normalization)."""
+
+    def _plugin(self):
+        cluster, plugin, engine, _ = make_env(nodes=("host-a",))
+        return cluster, plugin, engine
+
+    def test_opportunistic_node_score_formula(self):
+        from kubeshare_tpu.scheduler.podspec import PodStatus
+
+        cluster, plugin, engine = self._plugin()
+        # occupy chip 0 with 0.4: score = (4*60 + 0.4*100 - 3/4*100) / 4
+        cluster.create_pod(shared_pod("seed", request="0.4", limit="1.0"))
+        engine.run_until_idle()
+        status = PodStatus(namespace="default", name="x")
+        score = plugin._opportunistic_node_score("host-a", status)
+        expected = (4 * 60 + 0.4 * 100 - (3 / 4) * 100) / 4
+        assert abs(score - expected) < 1e-9
+
+    def test_guarantee_node_score_formula(self):
+        from kubeshare_tpu.scheduler.podspec import PodStatus
+
+        cluster, plugin, engine = self._plugin()
+        cluster.create_pod(shared_pod("seed", request="0.4", limit="1.0"))
+        engine.run_until_idle()
+        status = PodStatus(namespace="default", name="x", priority=50)
+        # no gang peers: score = (sum(priority - usage*100)) / n
+        score = plugin._guarantee_node_score("host-a", status)
+        expected = (4 * 60 - 0.4 * 100) / 4
+        assert abs(score - expected) < 1e-9
+
+    def test_normalize_scores_reference_behavior(self):
+        cluster, plugin, engine = self._plugin()
+        # all within [0,100] after negative shift: returned shifted only
+        assert plugin.normalize_scores({"a": -50.0, "b": 50.0}) == {
+            "a": 0, "b": 100}
+        # wide range rescaled into [0,100]
+        normalized = plugin.normalize_scores({"a": 0.0, "b": 1000.0})
+        assert normalized["a"] == 0 and normalized["b"] == 100
+        # equal scores: no division blowup
+        same = plugin.normalize_scores({"a": 500.0, "b": 500.0})
+        assert same["a"] == same["b"]
+        assert plugin.normalize_scores({}) == {}
+
+    def test_locality_prefers_gang_peer_chip_neighborhood(self):
+        cluster, plugin, engine, _ = make_env(nodes=("host-a", "host-b"))
+        # first gang member lands somewhere; second must prefer the same
+        # node (ICI coords distance 1 vs cross-node distance)
+        for i in range(2):
+            cluster.create_pod(shared_pod(
+                f"g{i}", request="1.0", limit="1.0",
+                group="loc", headcount=2, threshold=0.5, priority="50"))
+        engine.run_until_idle()
+        nodes = {cluster.get_pod("default", f"g{i}").node_name for i in range(2)}
+        assert len(nodes) == 1  # co-located for locality
