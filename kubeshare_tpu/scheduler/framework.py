@@ -54,15 +54,33 @@ class SchedulerEngine:
         self._attempt_timestamps: Dict[str, float] = {}
         self._sort_keys: Dict[str, tuple] = {}
         self._sort_key_uids: Dict[str, str] = {}
+        # event-maintained pending set (the reference rides kube-scheduler's
+        # event-driven queue; re-listing every cycle is O(P) per pod)
+        self._pending: Dict[str, Pod] = {}
+        for pod in cluster.list_pods(scheduler_name=constants.SCHEDULER_NAME):
+            if not pod.is_bound() and not pod.is_completed():
+                self._pending[pod.key] = pod
+        cluster.add_pod_handler(self._on_pod_event)
+
+    def _on_pod_event(self, event: str, obj: object) -> None:
+        pod = obj
+        if not isinstance(pod, Pod) or pod.scheduler_name != constants.SCHEDULER_NAME:
+            return
+        if event == "delete" or pod.is_bound() or pod.is_completed():
+            self._pending.pop(pod.key, None)
+        else:
+            self._pending[pod.key] = pod
 
     # ------------------------------------------------------------------
     def pending_pods(self) -> List[Pod]:
         waiting_keys = {
             w.pod.key for group in self._waiting.values() for w in group
         }
+        # re-verify liveness at read time: under an eventually-consistent
+        # watch (real k8s) the event stream may lag the API state
         pods = [
             p
-            for p in self.cluster.list_pods(scheduler_name=constants.SCHEDULER_NAME)
+            for p in list(self._pending.values())
             if not p.is_bound() and not p.is_completed()
             and p.key not in waiting_keys
         ]
