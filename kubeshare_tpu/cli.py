@@ -123,6 +123,7 @@ def cmd_launcher(args: argparse.Namespace) -> int:
     if not uuids:
         log.error("no chips found and none specified via --chip-uuids")
         return 1
+    metric_servers = []
     for i, uuid in enumerate(uuids):
         supervisor = ChipSupervisor(
             uuid,
@@ -134,7 +135,13 @@ def cmd_launcher(args: argparse.Namespace) -> int:
         supervisor.start()
         supervisors.append(supervisor)
         log.info("chip %s: tokend on port %d", uuid, args.base_port + i)
+        if args.metrics_base_port >= 0:
+            server = supervisor.serve_metrics(port=args.metrics_base_port + i)
+            metric_servers.append(server)
+            log.info("chip %s: metrics on :%d/metrics", uuid, server.port)
     _serve_forever()
+    for server in metric_servers:
+        server.stop()
     for supervisor in supervisors:
         supervisor.stop()
     return 0
@@ -256,6 +263,8 @@ def main(argv=None) -> int:
     p.add_argument("--config-dir", default=constants.CHIP_CONFIG_DIR)
     p.add_argument("--port-dir", default=constants.POD_MANAGER_PORT_DIR)
     p.add_argument("--base-port", type=int, default=constants.TOKEND_BASE_PORT)
+    p.add_argument("--metrics-base-port", type=int, default=9010,
+                   help="per-chip runtime metrics ports; -1 disables")
     p.set_defaults(fn=cmd_launcher)
 
     p = sub.add_parser("scheduler", help="scheduling control loop (ref pkg/scheduler)")

@@ -198,6 +198,48 @@ class ChipSupervisor:
         except subprocess.TimeoutExpired:
             pass
 
+    # ------------------------------------------------------------------
+    def collect_metrics(self):
+        """Translate tokend STAT into Prometheus gauges (observability the
+        reference's Gemini side never had — its logs were the only window,
+        SURVEY §5)."""
+        import json
+        import socket as socketlib
+
+        from ..utils.promtext import MetricFamily
+
+        share = MetricFamily("tpushare_pod_share",
+                             "Decayed device-time share per pod.", "gauge")
+        mem = MetricFamily("tpushare_pod_mem_used_bytes",
+                           "Accounted HBM per pod.", "gauge")
+        grants = MetricFamily("tpushare_pod_grants_total",
+                              "Token grants per pod.", "counter")
+        waiters = MetricFamily("tpushare_waiters",
+                               "Pods currently waiting for a token.", "gauge")
+        try:
+            with socketlib.create_connection(
+                ("127.0.0.1", self.tokend_port), timeout=2
+            ) as sock:
+                sock.sendall(b"STAT\n")
+                data = sock.makefile().readline()
+            stat = json.loads(data)
+        except (OSError, ValueError):
+            return [share, mem, grants, waiters]
+        waiters.add({"chip": self.chip_uuid}, stat.get("waiters", 0))
+        for pod, info in stat.get("pods", {}).items():
+            labels = {"chip": self.chip_uuid, "pod": pod}
+            share.add(labels, info.get("share", 0.0))
+            mem.add(labels, info.get("mem_used", 0))
+            grants.add(labels, info.get("grants", 0))
+        return [share, mem, grants, waiters]
+
+    def serve_metrics(self, port: int = 0):
+        from ..utils.promtext import MetricServer
+
+        server = MetricServer(self.collect_metrics, port=port)
+        server.start()
+        return server
+
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:

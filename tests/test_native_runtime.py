@@ -471,3 +471,33 @@ class TestIdleRelease:
         t.join(timeout=5)  # idle monitor releases
         assert granted
         a.close(); b.close()
+
+
+class TestSupervisorMetrics:
+    def test_tokend_stat_as_prometheus(self, tmp_path):
+        import urllib.request
+
+        config_dir = tmp_path / "config"
+        port_dir = tmp_path / "ports"
+        config_dir.mkdir(); port_dir.mkdir()
+        write_atomic(str(config_dir / "chip-0"), "1\nns/p 1.0 0.5 4096\n")
+        write_atomic(str(port_dir / "chip-0"), "0\n")
+        tokend_port = free_port()
+        with ChipSupervisor("chip-0", config_dir=str(config_dir),
+                            port_dir=str(port_dir), tokend_port=tokend_port,
+                            poll_interval=0.2) as sup:
+            wait_listening(tokend_port)
+            client = TokenClient("127.0.0.1", tokend_port, "ns/p")
+            client.acquire(); client.release(5.0)
+            client.request_memory(1000)
+            client.close()
+            server = sup.serve_metrics(port=0)
+            try:
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/metrics", timeout=5
+                ).read().decode()
+                assert 'tpushare_pod_grants_total{chip="chip-0",pod="ns/p"} 1' in body
+                assert 'tpushare_pod_mem_used_bytes{chip="chip-0",pod="ns/p"} 1000' in body
+                assert "tpushare_pod_share" in body
+            finally:
+                server.stop()
