@@ -140,13 +140,15 @@ class Phase:
     through a ready barrier.  A fresh tokend per phase keeps residual
     usage-window state from one phase from biasing the next."""
 
-    def __init__(self, pods, tokend_binary, seconds, batch, smoke, io_wait_ms):
+    def __init__(self, pods, tokend_binary, seconds, batch, smoke, io_wait_ms,
+                 ready_timeout=300.0):
         self.pods = pods
         self.tokend_binary = tokend_binary
         self.seconds = seconds
         self.batch = batch
         self.smoke = smoke
         self.io_wait_ms = io_wait_ms
+        self.ready_timeout = ready_timeout
 
     def run(self):
         workdir = tempfile.mkdtemp(prefix="tpushare-bench-")
@@ -182,10 +184,25 @@ class Phase:
                     cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
                     text=True, cwd=REPO,
                 ))
+            import threading
+
+            def read_ready(proc, out):
+                out.append(proc.stdout.readline().strip())
+
             for proc in procs:
-                line = proc.stdout.readline().strip()
-                if line != "READY":
-                    raise RuntimeError(f"worker failed before ready: {line!r}")
+                out: list = []
+                reader = threading.Thread(target=read_ready, args=(proc, out),
+                                          daemon=True)
+                reader.start()
+                # watchdog: a hung accelerator runtime must fail loudly, not
+                # stall the benchmark forever
+                reader.join(timeout=self.ready_timeout)
+                if not out or out[0] != "READY":
+                    state = out[0] if out else "no output (runtime hung?)"
+                    raise RuntimeError(
+                        f"worker not ready within {self.ready_timeout:.0f}s: "
+                        f"{state!r}"
+                    )
             open(barrier, "w").close()
             results = []
             for proc in procs:
