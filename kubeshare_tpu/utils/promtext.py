@@ -81,6 +81,17 @@ def parse_text(text: str) -> List[Sample]:
         name_part, _, value_part = line.rpartition(" ")
         if not name_part:
             continue
+        # exposition lines may carry an optional trailing timestamp
+        # ("name{...} value ts"); peel it so foreign exporters parse too
+        head, _, prev = name_part.rpartition(" ")
+        if head and ("}" in head or "{" not in name_part):
+            try:
+                float(value_part)
+                float(prev)
+            except ValueError:
+                pass
+            else:
+                name_part, value_part = head, prev
         labels: Dict[str, str] = {}
         name = name_part
         if "{" in name_part:

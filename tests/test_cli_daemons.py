@@ -1,0 +1,76 @@
+"""Daemon-main lifecycle tests: start each CLI component as a real process,
+observe it working, terminate cleanly with SIGTERM."""
+
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+import os
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def test_scheduler_daemon_lifecycle(tmp_path):
+    config = tmp_path / "topology.yaml"
+    config.write_text("""
+cellTypes:
+  N:
+    childCellType: TPU-v4
+    childCellNumber: 2
+    childCellPriority: 60
+    isNodeLevel: true
+cells:
+- cellType: N
+  cellId: n1
+""")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubeshare_tpu", "scheduler",
+         "--cluster", "fake", "--kubeshare-config", str(config),
+         "--metrics-port", "0", "--idle-interval", "0.1"],
+        cwd=REPO, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        # wait for the metrics server log line, scrape it
+        port = None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            line = proc.stderr.readline()
+            if "scheduler metrics on :" in line:
+                port = int(line.rsplit(":", 1)[-1].split("/")[0])
+                break
+        assert port, "scheduler never reported metrics port"
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "kubeshare_scheduler_pods" in body
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=15) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def test_configd_daemon_lifecycle(tmp_path):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubeshare_tpu", "configd",
+         "--cluster", "fake", "--node-name", "n1",
+         "--config-dir", str(tmp_path / "config"),
+         "--port-dir", str(tmp_path / "ports"),
+         "--sync-interval", "0.1",
+         "--write-scheduler-ip", "10.1.2.3",
+         "--library-path", str(tmp_path / "lib")],
+        cwd=REPO, stderr=subprocess.DEVNULL, text=True,
+    )
+    try:
+        deadline = time.time() + 20
+        ip_file = tmp_path / "lib" / "schedulerIP.txt"
+        while time.time() < deadline and not ip_file.exists():
+            time.sleep(0.1)
+        assert ip_file.read_text().strip() == "10.1.2.3"
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=15) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()

@@ -127,3 +127,19 @@ class TestAtomicFile:
             stop.set()
             t.join()
         assert not errors
+
+
+class TestPromTimestampLines:
+    def test_trailing_timestamp_peeled(self):
+        text = 'gpu_capacity{node="n",uuid="u"} 123 1700000000123\n'
+        [sample] = parse_text(text)
+        assert sample.value == 123
+        assert sample.labels["uuid"] == "u"
+
+    def test_no_timestamp_unchanged(self):
+        [sample] = parse_text('m{a="b"} 4.5\n')
+        assert sample.value == 4.5
+        [bare] = parse_text("plain_metric 7\n")
+        assert bare.name == "plain_metric" and bare.value == 7
+        [bare_ts] = parse_text("plain_metric 7 1700000000\n")
+        assert bare_ts.name == "plain_metric" and bare_ts.value == 7
