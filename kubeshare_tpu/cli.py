@@ -197,6 +197,7 @@ def cmd_simulate(args: argparse.Namespace) -> int:
         chips_per_node=args.chips_per_node,
         time_scale=args.time_scale,
         seed=args.seed,
+        gang_fraction=args.gang_fraction,
     )
     print(report.to_json())
     return 0
@@ -287,6 +288,8 @@ def main(argv=None) -> int:
     p.add_argument("--time-scale", type=float, default=0.0,
                    help="0 = as fast as possible")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--gang-fraction", type=float, default=0.0,
+                   help="fraction of arrivals that are coscheduled gangs")
     p.set_defaults(fn=cmd_simulate)
 
     args = parser.parse_args(argv)

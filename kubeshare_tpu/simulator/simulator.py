@@ -73,6 +73,7 @@ def run_trace(
     chips_per_node: int = 4,
     time_scale: float = 0.0,
     seed: int = 0,
+    gang_fraction: float = 0.0,
 ) -> SimulationReport:
     """Replay a trace through the scheduler on a virtual cluster.
 
@@ -135,16 +136,30 @@ def run_trace(
                 limit = "1.0"
             else:
                 request = limit = f"{entry.chips}.0" if entry.chips else "0.5"
-            pod = Pod(
-                name=f"sim-{i}-g{entry.chips}",
-                labels={
-                    constants.POD_GPU_REQUEST: request,
-                    constants.POD_GPU_LIMIT: limit,
-                },
-                scheduler_name=constants.SCHEDULER_NAME,
-            )
-            cluster.create_pod(pod)
-            report.submitted += 1
+            labels = {
+                constants.POD_GPU_REQUEST: request,
+                constants.POD_GPU_LIMIT: limit,
+            }
+            members = 1
+            if gang_fraction > 0 and rng.random() < gang_fraction:
+                # gang arrival: a small coscheduled group (exercises the
+                # Permit barrier + timeout rollback under churn; the
+                # reference trace had only singleton pods)
+                members = rng.choice([2, 3])
+                labels[constants.POD_GROUP_NAME] = f"gang-{i}"
+                labels[constants.POD_GROUP_HEADCOUNT] = str(members)
+                labels[constants.POD_GROUP_THRESHOLD] = "1.0"
+                labels[constants.POD_GPU_REQUEST] = "0.5"
+                labels[constants.POD_GPU_LIMIT] = "1.0"
+            for member in range(members):
+                pod = Pod(
+                    name=f"sim-{i}-g{entry.chips}" + (
+                        f"-m{member}" if members > 1 else ""),
+                    labels=dict(labels),
+                    scheduler_name=constants.SCHEDULER_NAME,
+                )
+                cluster.create_pod(pod)
+                report.submitted += 1
             for result in engine.run_until_idle(max_cycles=50):
                 report.scheduling_cycles += 1
                 if result.result == "bound":

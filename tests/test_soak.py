@@ -190,3 +190,19 @@ def test_supervisor_restarts_crashed_tokend(tmp_path):
                 time.sleep(0.1)
         else:
             raise AssertionError("restarted tokend never listened")
+
+
+def test_gang_churn_simulation_invariants():
+    """Gangs arriving/departing under load: no partial-gang leaks, no
+    oversubscription, full reclamation at drain."""
+    import os
+
+    from kubeshare_tpu.simulator import run_trace
+
+    trace = os.path.join(os.path.dirname(__file__), "..", "examples",
+                         "trace-small.txt")
+    report = run_trace(trace, nodes=2, chips_per_node=4, gang_fraction=0.4,
+                       seed=3)
+    assert report.submitted > 60  # gangs add members
+    assert report.bound + report.unschedulable == report.submitted
+    assert report.bound > 0
