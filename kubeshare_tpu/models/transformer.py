@@ -120,23 +120,28 @@ def _forward(params, tokens, config, attention_fn, pos_offset):
         x = x + pos.astype(dtype)
 
     for layer in params["layers"]:
-        # attention block
-        y = _rms_norm(x, layer["norm1"]["scale"])
-        q = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wq"].astype(dtype))
-        k = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wk"].astype(dtype))
-        v = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wv"].astype(dtype))
-        if use_rope:
-            q = apply_rope(q, positions)
-            k = apply_rope(k, positions)
-        o = attention_fn(q, k, v).astype(dtype)
-        x = x + jnp.einsum("bhsk,hkd->bsd", o, layer["attn"]["wo"].astype(dtype))
-        # mlp block
-        y = _rms_norm(x, layer["norm2"]["scale"])
-        y = jax.nn.gelu(y @ layer["mlp"]["w_in"].astype(dtype))
-        x = x + y @ layer["mlp"]["w_out"].astype(dtype)
+        x = _layer_forward(layer, x, attention_fn, dtype,
+                           positions if use_rope else None)
 
     x = _rms_norm(x, params["final_norm"]["scale"])
     return (x @ params["lm_head"].astype(dtype)).astype(jnp.float32)
+
+
+def _layer_forward(layer, x, attention_fn, dtype, rope_positions_or_none):
+    # attention block
+    y = _rms_norm(x, layer["norm1"]["scale"])
+    q = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wq"].astype(dtype))
+    k = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wk"].astype(dtype))
+    v = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wv"].astype(dtype))
+    if rope_positions_or_none is not None:
+        q = apply_rope(q, rope_positions_or_none)
+        k = apply_rope(k, rope_positions_or_none)
+    o = attention_fn(q, k, v).astype(dtype)
+    x = x + jnp.einsum("bhsk,hkd->bsd", o, layer["attn"]["wo"].astype(dtype))
+    # mlp block
+    y = _rms_norm(x, layer["norm2"]["scale"])
+    y = jax.nn.gelu(y @ layer["mlp"]["w_in"].astype(dtype))
+    return x + y @ layer["mlp"]["w_out"].astype(dtype)
 
 
 def transformer_apply(
@@ -217,3 +222,53 @@ def transformer_sharding_rules() -> Dict[str, P]:
 def transformer_activation_spec(use_sp: bool = True) -> P:
     """Sharding for the [batch, seq] token array."""
     return P("dp", "sp") if use_sp else P("dp", None)
+
+
+def transformer_apply_pipelined(
+    params: Dict,
+    tokens: jax.Array,
+    config: TransformerConfig,
+    mesh: Mesh,
+    num_microbatches: int = 2,
+    pp_axis: str = "pp",
+) -> jax.Array:
+    """Pipeline-parallel forward: layers split into pp stages (GPipe over
+    ``pp_axis``, parallel.pipeline); embedding and head run replicated
+    outside the pipeline.  Requires n_layers % pp == 0."""
+    from ..parallel.pipeline import pipeline_apply, stack_stage_params
+
+    if config.attention == "ring":
+        raise ValueError("pipelined path does not compose with ring yet")
+    n_stages = mesh.shape[pp_axis]
+    if config.n_layers % n_stages != 0:
+        raise ValueError(
+            f"n_layers {config.n_layers} not divisible into {n_stages} stages"
+        )
+    per_stage = config.n_layers // n_stages
+    dtype = config.dtype
+    attention_fn = _select_attention(config)
+    use_rope = config.positional == "rope"
+    positions = rope_positions(tokens.shape[1], 0) if use_rope else None
+
+    x = params["embed"][tokens].astype(dtype)
+    if not use_rope:
+        x = x + params["pos_embed"][: tokens.shape[1]].astype(dtype)
+
+    # stack each stage's layers: leaves [pp, per_stage, ...]
+    stages = [
+        jax.tree.map(lambda *ls: jnp.stack(ls),
+                     *params["layers"][s * per_stage:(s + 1) * per_stage])
+        for s in range(n_stages)
+    ]
+    stacked = stack_stage_params(stages)
+
+    def stage_fn(stage_layers, x):
+        def body(x, layer):
+            return _layer_forward(layer, x, attention_fn, dtype, positions), None
+
+        x, _ = jax.lax.scan(body, x, stage_layers)
+        return x
+
+    x = pipeline_apply(stacked, x, stage_fn, mesh, num_microbatches, pp_axis)
+    x = _rms_norm(x, params["final_norm"]["scale"])
+    return (x @ params["lm_head"].astype(dtype)).astype(jnp.float32)
