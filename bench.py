@@ -141,7 +141,7 @@ class Phase:
     usage-window state from one phase from biasing the next."""
 
     def __init__(self, pods, tokend_binary, seconds, batch, smoke, io_wait_ms,
-                 ready_timeout=300.0):
+                 ready_timeout=300.0, exclusive=False):
         self.pods = pods
         self.tokend_binary = tokend_binary
         self.seconds = seconds
@@ -149,6 +149,7 @@ class Phase:
         self.smoke = smoke
         self.io_wait_ms = io_wait_ms
         self.ready_timeout = ready_timeout
+        self.exclusive = exclusive
 
     def run(self):
         workdir = tempfile.mkdtemp(prefix="tpushare-bench-")
@@ -156,11 +157,11 @@ class Phase:
         with open(os.path.join(workdir, uuid), "w") as f:
             f.write("2\nbench/pod-a 1.0 0.5 0\nbench/pod-b 1.0 0.5 0\n")
         port = free_port()
-        tokend = subprocess.Popen(
-            [self.tokend_binary, "-p", workdir, "-f", uuid, "-P", str(port),
-             "-q", "300", "-m", "20", "-w", "10000"],
-            stderr=subprocess.DEVNULL,
-        )
+        cmd = [self.tokend_binary, "-p", workdir, "-f", uuid, "-P", str(port),
+               "-q", "300", "-m", "20", "-w", "10000"]
+        if self.exclusive:
+            cmd.append("-x")
+        tokend = subprocess.Popen(cmd, stderr=subprocess.DEVNULL)
         barrier = tempfile.mktemp(prefix="tpushare-barrier-")
         procs = []
         try:
@@ -233,6 +234,8 @@ def main() -> None:
     parser.add_argument("--barrier", default="")
     parser.add_argument("--io-wait-ms", type=float, default=4.0,
                         help="per-step input-pipeline wait")
+    parser.add_argument("--exclusive", action="store_true",
+                        help="strict Gemini-style exclusive time slicing")
     args = parser.parse_args()
 
     if args.seconds is None:
@@ -247,7 +250,7 @@ def main() -> None:
     tokend_binary = ensure_tokend()
     common = dict(tokend_binary=tokend_binary, seconds=args.seconds,
                   batch=args.batch, smoke=args.smoke,
-                  io_wait_ms=args.io_wait_ms)
+                  io_wait_ms=args.io_wait_ms, exclusive=args.exclusive)
     solo_a_res = Phase(["bench/pod-a"], **common).run()[0]
     solo_b_res = Phase(["bench/pod-b"], **common).run()[0]
     solo_a = solo_a_res["steps"] / args.seconds

@@ -5,12 +5,14 @@ from .transformer import (
     transformer_init,
     transformer_apply,
     transformer_apply_ring,
+    transformer_apply_pipelined,
     transformer_sharding_rules,
 )
 from .decoding import greedy_decode, init_kv_cache, prefill
 
 __all__ = [
     "transformer_apply_ring",
+    "transformer_apply_pipelined",
     "transformer_sharding_rules",
     "greedy_decode",
     "init_kv_cache",
