@@ -190,14 +190,19 @@ class Phase:
             def read_ready(proc, out):
                 out.append(proc.stdout.readline().strip())
 
+            # watchdog: start all readers first, join against one shared
+            # deadline — a hung accelerator runtime fails loudly at
+            # ready_timeout, not N x ready_timeout
+            readers = []
             for proc in procs:
                 out: list = []
                 reader = threading.Thread(target=read_ready, args=(proc, out),
                                           daemon=True)
                 reader.start()
-                # watchdog: a hung accelerator runtime must fail loudly, not
-                # stall the benchmark forever
-                reader.join(timeout=self.ready_timeout)
+                readers.append((reader, out))
+            deadline = time.monotonic() + self.ready_timeout
+            for reader, out in readers:
+                reader.join(timeout=max(0.0, deadline - time.monotonic()))
                 if not out or out[0] != "READY":
                     state = out[0] if out else "no output (runtime hung?)"
                     raise RuntimeError(

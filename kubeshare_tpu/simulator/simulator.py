@@ -131,26 +131,29 @@ def run_trace(
         clock.advance(max(0.0, when - clock.now()))
         if kind == "arrive":
             i, entry = payload
-            if entry.chips > 2:
-                request = str(round(rng.random(), 2) or 0.01)
-                limit = "1.0"
-            else:
-                request = limit = f"{entry.chips}.0" if entry.chips else "0.5"
-            labels = {
-                constants.POD_GPU_REQUEST: request,
-                constants.POD_GPU_LIMIT: limit,
-            }
             members = 1
             if gang_fraction > 0 and rng.random() < gang_fraction:
                 # gang arrival: a small coscheduled group (exercises the
                 # Permit barrier + timeout rollback under churn; the
                 # reference trace had only singleton pods)
                 members = rng.choice([2, 3])
-                labels[constants.POD_GROUP_NAME] = f"gang-{i}"
-                labels[constants.POD_GROUP_HEADCOUNT] = str(members)
-                labels[constants.POD_GROUP_THRESHOLD] = "1.0"
-                labels[constants.POD_GPU_REQUEST] = "0.5"
-                labels[constants.POD_GPU_LIMIT] = "1.0"
+                labels = {
+                    constants.POD_GPU_REQUEST: "0.5",
+                    constants.POD_GPU_LIMIT: "1.0",
+                    constants.POD_GROUP_NAME: f"gang-{i}",
+                    constants.POD_GROUP_HEADCOUNT: str(members),
+                    constants.POD_GROUP_THRESHOLD: "1.0",
+                }
+            else:
+                if entry.chips > 2:
+                    request = str(round(rng.random(), 2) or 0.01)
+                    limit = "1.0"
+                else:
+                    request = limit = f"{entry.chips}.0" if entry.chips else "0.5"
+                labels = {
+                    constants.POD_GPU_REQUEST: request,
+                    constants.POD_GPU_LIMIT: limit,
+                }
             for member in range(members):
                 pod = Pod(
                     name=f"sim-{i}-g{entry.chips}" + (

@@ -31,15 +31,26 @@ cells:
         cwd=REPO, stderr=subprocess.PIPE, text=True,
     )
     try:
-        # wait for the metrics server log line, scrape it
-        port = None
-        deadline = time.time() + 30
-        while time.time() < deadline:
-            line = proc.stderr.readline()
-            if "scheduler metrics on :" in line:
-                port = int(line.rsplit(":", 1)[-1].split("/")[0])
-                break
-        assert port, "scheduler never reported metrics port"
+        # wait for the metrics server log line via a reader thread so a
+        # hung daemon fails the test instead of hanging it
+        import threading
+
+        found: list = []
+
+        def scan():
+            while True:
+                line = proc.stderr.readline()
+                if not line:
+                    return
+                if "scheduler metrics on :" in line:
+                    found.append(int(line.rsplit(":", 1)[-1].split("/")[0]))
+                    return
+
+        reader = threading.Thread(target=scan, daemon=True)
+        reader.start()
+        reader.join(timeout=30)
+        assert found, "scheduler never reported metrics port"
+        port = found[0]
         body = urllib.request.urlopen(
             f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
         assert "kubeshare_scheduler_pods" in body
