@@ -37,6 +37,7 @@ class TransformerConfig:
     attention: str = "auto"  # auto | reference | flash | ring
     attention_window: Optional[int] = None  # sliding-window (local) size
     positional: str = "learned"  # learned | rope
+    remat: bool = False  # jax.checkpoint each layer (HBM for FLOPs)
 
     @property
     def head_dim(self) -> int:
@@ -119,9 +120,16 @@ def _forward(params, tokens, config, attention_fn, pos_offset):
         pos = jax.lax.dynamic_slice_in_dim(params["pos_embed"], pos_offset, seq)
         x = x + pos.astype(dtype)
 
+    layer_fn = _layer_forward
+    if config.remat:
+        # rematerialize each layer's activations in the backward pass —
+        # the standard HBM-for-FLOPs trade for long sequences / deep stacks
+        layer_fn = jax.checkpoint(
+            _layer_forward, static_argnums=(2, 3)
+        )
     for layer in params["layers"]:
-        x = _layer_forward(layer, x, attention_fn, dtype,
-                           positions if use_rope else None)
+        x = layer_fn(layer, x, attention_fn, dtype,
+                     positions if use_rope else None)
 
     x = _rms_norm(x, params["final_norm"]["scale"])
     return (x @ params["lm_head"].astype(dtype)).astype(jnp.float32)

@@ -540,3 +540,23 @@ class TestRope:
         )
         with pytest.raises(ValueError):
             transformer_init(jax.random.PRNGKey(0), bad)
+
+
+class TestRemat:
+    def test_remat_grads_match(self):
+        base = dict(vocab_size=32, d_model=16, n_heads=2, n_layers=2,
+                    d_ff=32, max_seq_len=16, dtype=jnp.float32,
+                    attention="reference")
+        plain = TransformerConfig(**base)
+        remat = TransformerConfig(**base, remat=True)
+        params = transformer_init(jax.random.PRNGKey(0), plain)
+        tokens = jnp.ones((2, 8), jnp.int32)
+
+        def loss(config):
+            return lambda p: (transformer_apply(p, tokens, config) ** 2).mean()
+
+        g_plain = jax.grad(loss(plain))(params)
+        g_remat = jax.grad(loss(remat))(params)
+        for a, b in zip(jax.tree.leaves(g_plain), jax.tree.leaves(g_remat)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
