@@ -84,3 +84,24 @@ class TestTranslation:
             status=attrdict(conditions=[attrdict(type="Ready", status="False")]),
         )
         assert not _to_node(not_ready).is_healthy()
+
+
+class TestManifestConstruction:
+    def test_pod_manifest_round_trip(self):
+        from kubeshare_tpu.cluster.api import Container, Pod
+        from kubeshare_tpu.cluster.k8s import K8sCluster
+
+        pod = Pod(
+            namespace="ns", name="p",
+            labels={"sharedgpu/gpu_request": "0.5"},
+            annotations={"sharedgpu/gpu_uuid": "tpu-0"},
+            scheduler_name="kubeshare-scheduler",
+            node_name="host-a",
+            containers=[Container(name="c", env={"POD_NAME": "ns/p"})],
+        )
+        manifest = K8sCluster._pod_manifest(None, pod)
+        assert manifest["metadata"]["labels"]["sharedgpu/gpu_request"] == "0.5"
+        assert manifest["spec"]["schedulerName"] == "kubeshare-scheduler"
+        assert manifest["spec"]["nodeName"] == "host-a"
+        env = manifest["spec"]["containers"][0]["env"]
+        assert {"name": "POD_NAME", "value": "ns/p"} in env
