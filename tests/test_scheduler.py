@@ -657,3 +657,39 @@ class TestScoringFormulas:
         engine.run_until_idle()
         nodes = {cluster.get_pod("default", f"g{i}").node_name for i in range(2)}
         assert len(nodes) == 1  # co-located for locality
+
+
+class TestNamespaceIsolation:
+    def test_same_group_name_different_namespaces(self):
+        cluster, plugin, engine, _ = make_env()
+        # two namespaces each run a gang called "team" with threshold 1.0;
+        # each must only count its own members (ref keys groups by ns/name)
+        for ns in ("alpha", "beta"):
+            for i in range(2):
+                cluster.create_pod(shared_pod(
+                    f"w{i}", request="0.5", limit="1.0",
+                    group="team", headcount=2, threshold=1.0, namespace=ns))
+        results = engine.run_until_idle()
+        placed = [p for p in cluster.list_pods() if p.is_bound()]
+        assert len(placed) == 4
+        assert plugin.pod_groups.get("alpha/team") is not None
+        assert plugin.pod_groups.get("beta/team") is not None
+        # deleting alpha's gang leaves beta's group alive
+        cluster.delete_pod("alpha", "w0")
+        cluster.delete_pod("alpha", "w1")
+        assert plugin.pod_groups.get("alpha/team").deletion_timestamp is not None
+        assert plugin.pod_groups.get("beta/team").deletion_timestamp is None
+
+    def test_same_pod_name_different_namespaces(self):
+        cluster, plugin, engine, _ = make_env(nodes=("host-a",))
+        for ns in ("alpha", "beta"):
+            cluster.create_pod(shared_pod("same-name", request="0.5",
+                                          limit="1.0", namespace=ns))
+        engine.run_until_idle()
+        a = cluster.get_pod("alpha", "same-name")
+        b = cluster.get_pod("beta", "same-name")
+        assert a.is_bound() and b.is_bound()
+        # distinct manager ports and tracked statuses
+        assert (a.annotations[constants.POD_MANAGER_PORT]
+                != b.annotations[constants.POD_MANAGER_PORT])
+        assert {"alpha/same-name", "beta/same-name"} <= set(plugin.pod_status)
