@@ -289,4 +289,17 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # emit a parseable record instead of a traceback
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({
+            "metric": "2-pod x 0.5-chip MNIST co-run aggregate vs summed solo",
+            "value": 0.0,
+            "unit": "ratio",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        sys.exit(1)
