@@ -130,6 +130,9 @@ def cmd_launcher(args: argparse.Namespace) -> int:
             config_dir=args.config_dir,
             port_dir=args.port_dir,
             tokend_port=args.base_port + i,
+            base_quota_ms=args.base_quota,
+            min_quota_ms=args.min_quota,
+            window_ms=args.window,
             log_dir=args.log_dir,
         )
         supervisor.start()
@@ -266,6 +269,13 @@ def main(argv=None) -> int:
     p.add_argument("--base-port", type=int, default=constants.TOKEND_BASE_PORT)
     p.add_argument("--metrics-base-port", type=int, default=9010,
                    help="per-chip runtime metrics ports; -1 disables")
+    p.add_argument("--base-quota", type=float,
+                   default=constants.TOKEN_BASE_QUOTA_MS,
+                   help="token base quota ms (ref launcher.py:78)")
+    p.add_argument("--min-quota", type=float,
+                   default=constants.TOKEN_MIN_QUOTA_MS)
+    p.add_argument("--window", type=float, default=constants.TOKEN_WINDOW_MS,
+                   help="sliding accounting window ms (ref launcher.py:80)")
     p.set_defaults(fn=cmd_launcher)
 
     p = sub.add_parser("scheduler", help="scheduling control loop (ref pkg/scheduler)")
