@@ -501,3 +501,22 @@ class TestSupervisorMetrics:
                 assert "tpushare_pod_share" in body
             finally:
                 server.stop()
+
+    def test_config_reload_preserves_usage(self, tokend):
+        # accumulate usage, then rewrite the config (same pod, new limits):
+        # the decayed usage must survive the reload (no accounting reset)
+        import json
+
+        client = TokenClient("127.0.0.1", tokend["port"], "ns/pod-a")
+        client.acquire()
+        client.release(200.0)  # 200ms of a 1000ms window -> share ~0.2
+        write_atomic(
+            str(tokend["config_dir"] / tokend["uuid"]),
+            "2\nns/pod-a 0.9 0.4 1000000\nns/pod-b 1.0 0.3 500000\n",
+        )
+        time.sleep(1.0)  # inotify reload + decay
+        stat = json.loads(client.stat())
+        pod_a = stat["pods"]["ns/pod-a"]
+        assert pod_a["limit"] == 0.9  # new config applied
+        assert pod_a["share"] > 0.05  # usage not reset (decayed from 0.2)
+        client.close()
