@@ -37,20 +37,45 @@ class FakeEnumerator:
 class JaxEnumerator:
     """Real enumeration via libtpu/PJRT; tolerates no-TPU hosts by exporting
     nothing (the reference idles forever when NVML init fails,
-    ref cmd/kubeshare-collector/main.go:42-49)."""
+    ref cmd/kubeshare-collector/main.go:42-49).
 
-    def __init__(self, backend: Optional[str] = None):
+    Discovery runs under a timeout: a dead accelerator runtime can HANG
+    backend init (observed with a downed tunnel), and a hung enumerator
+    would stall every scrape — better to export empty inventory (the
+    scheduler then treats the node as chipless) until the runtime recovers.
+    """
+
+    def __init__(self, backend: Optional[str] = None, timeout_s: float = 60.0):
         self._backend = backend
+        self._timeout_s = timeout_s
         self._log = get_logger("kubeshare-collector")
+        self._cache: List[ChipInfo] = []
 
     def __call__(self) -> List[ChipInfo]:
-        try:
-            from ..cell.topology import discover_local_chips
+        import threading
 
-            return discover_local_chips(self._backend)
-        except Exception as e:  # no TPU / no jax: export empty inventory
-            self._log.warning("chip enumeration failed: %s", e)
-            return []
+        result: List[List[ChipInfo]] = []
+
+        def discover() -> None:
+            try:
+                from ..cell.topology import discover_local_chips
+
+                result.append(discover_local_chips(self._backend))
+            except Exception as e:  # no TPU / no jax
+                self._log.warning("chip enumeration failed: %s", e)
+                result.append([])
+
+        worker = threading.Thread(target=discover, daemon=True)
+        worker.start()
+        worker.join(timeout=self._timeout_s)
+        if not result:
+            self._log.warning(
+                "chip enumeration hung > %.0fs; exporting last-known inventory",
+                self._timeout_s,
+            )
+            return list(self._cache)
+        self._cache = result[0]
+        return list(result[0])
 
 
 class Collector:

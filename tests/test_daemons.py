@@ -222,3 +222,23 @@ class TestMetadataBusIntegration:
             assert ports.startswith("2\n")
         finally:
             server.stop()
+
+
+class TestJaxEnumeratorTimeout:
+    def test_hung_discovery_returns_cached(self, monkeypatch):
+        import time as time_mod
+
+        from kubeshare_tpu.collector import JaxEnumerator
+        from kubeshare_tpu.cell import topology as topo
+
+        enumerator = JaxEnumerator(timeout_s=0.2)
+        # first call: discovery works
+        chips = [ChipInfo("t0", 1 << 30, "TPU-v4", 0)]
+        monkeypatch.setattr(topo, "discover_local_chips", lambda b=None: chips)
+        assert enumerator() == chips
+        # runtime dies: discovery hangs; enumerator returns last-known
+        monkeypatch.setattr(topo, "discover_local_chips",
+                            lambda b=None: time_mod.sleep(10))
+        start = time_mod.monotonic()
+        assert enumerator() == chips
+        assert time_mod.monotonic() - start < 2.0
