@@ -81,6 +81,22 @@ def _mask_scores(scores, q_idx, k_idx, causal, block_q, block_k, window):
     return jnp.where(mask, scores, -jnp.inf)
 
 
+def _kv_head_map(group: int, order: str):
+    """K/V BlockSpec index map; the MQA/GQA head-group floordiv only enters
+    the lowering when group > 1 (the dense path keeps the plain map).
+
+    ``order``: which of the two trailing grid axes is the K-block axis —
+    "qk" for grids (b, h, q, k), "kq" for grids (b, h, k, q).
+    """
+    if order == "qk":
+        if group == 1:
+            return lambda bi, hi, qi, ki: (bi, hi, ki, 0)
+        return lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)
+    if group == 1:
+        return lambda bi, hi, ki, qi: (bi, hi, ki, 0)
+    return lambda bi, hi, ki, qi: (bi, hi // group, ki, 0)
+
+
 def _attention_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     *, causal: bool, block_q: int, block_k: int, n_kblocks: int,
@@ -184,10 +200,8 @@ def _flash_forward(
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), _kv_head_map(group, "qk")),
+            pl.BlockSpec((1, 1, block_k, d), _kv_head_map(group, "qk")),
         ],
         out_specs=(
             pl.BlockSpec((1, 1, block_q, d),
@@ -339,10 +353,8 @@ def _flash_backward(
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, ki, qi: (bi, hi, qi, 0)),  # q
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, ki, qi: (bi, hi // group, ki, 0)),  # k
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, ki, qi: (bi, hi // group, ki, 0)),  # v
+            pl.BlockSpec((1, 1, block_k, d), _kv_head_map(group, "kq")),  # k
+            pl.BlockSpec((1, 1, block_k, d), _kv_head_map(group, "kq")),  # v
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, ki, qi: (bi, hi, qi, 0)),  # dO
             pl.BlockSpec((1, 1, block_q, 1),
@@ -378,10 +390,8 @@ def _flash_backward(
         grid=(b, h, n_qblocks, n_kblocks),
         in_specs=[
             qd_spec,  # q
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),  # k
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),  # v
+            pl.BlockSpec((1, 1, block_k, d), _kv_head_map(group, "qk")),  # k
+            pl.BlockSpec((1, 1, block_k, d), _kv_head_map(group, "qk")),  # v
             qd_spec,  # dO
             row_spec,  # lse
             row_spec,  # delta
