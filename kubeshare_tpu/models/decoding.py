@@ -27,14 +27,21 @@ def init_kv_cache(config: TransformerConfig, batch: int) -> Dict:
     }
 
 
-def _attend_cached(q, cache_k, cache_v, length):
-    """q: [b,h,1,d] against cache [b,h,S,d]; positions >= length masked."""
+def _attend_cached(q, cache_k, cache_v, length, window=None):
+    """q: [b,h,1,d] against cache [b,h,S,d]; positions >= length masked.
+
+    With sliding-window attention the query sits at position ``length - 1``
+    and may only see keys where ``q_pos - k_pos < window``, i.e. positions
+    ``>= length - window`` — the same band transformer_apply's dense mask
+    keeps (ops/attention.py window semantics).
+    """
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, cache_k).astype(jnp.float32) * scale
     positions = jnp.arange(cache_k.shape[2])
-    scores = jnp.where(
-        positions[None, None, None, :] < length, scores, -jnp.inf
-    )
+    valid = positions[None, None, None, :] < length
+    if window is not None:
+        valid = valid & (positions[None, None, None, :] >= length - window)
+    scores = jnp.where(valid, scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1).astype(cache_v.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, cache_v)
 
@@ -66,7 +73,9 @@ def _decode_one(params, config: TransformerConfig, cache: Dict, token: jax.Array
         )
         new_k.append(cache_k)
         new_v.append(cache_v)
-        o = _attend_cached(q, cache_k, cache_v, position + 1).astype(dtype)
+        o = _attend_cached(
+            q, cache_k, cache_v, position + 1, window=config.attention_window
+        ).astype(dtype)
         x = x + jnp.einsum("bhsk,hkd->bsd", o, layer["attn"]["wo"].astype(dtype))
         y = _rms_norm(x, layer["norm2"]["scale"])
         y = jax.nn.gelu(y @ layer["mlp"]["w_in"].astype(dtype))

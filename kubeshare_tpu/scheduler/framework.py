@@ -215,6 +215,12 @@ class SchedulerEngine:
         # gpu_mem annotation only if the scheduler injected it (label absent)
         if constants.POD_GPU_MEMORY not in current.labels:
             reverted.annotations.pop(constants.POD_GPU_MEMORY, None)
+        from ..parallel.distributed import (
+            ENV_GANG_NAME,
+            ENV_GANG_RANK,
+            ENV_GANG_SIZE,
+        )
+
         injected_env = (
             constants.ENV_VISIBLE_CHIPS,
             constants.ENV_SHIM_PRELOAD,
@@ -222,6 +228,9 @@ class SchedulerEngine:
             constants.ENV_POD_NAME,
             constants.ENV_MEM_BYTES,
             constants.ENV_MEM_FRACTION,
+            ENV_GANG_NAME,
+            ENV_GANG_SIZE,
+            ENV_GANG_RANK,
         )
         for container in reverted.containers:
             for name in injected_env:

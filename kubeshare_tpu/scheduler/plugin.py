@@ -597,15 +597,21 @@ class KubeShareScheduler:
         return assumed
 
     def _gang_env(self, pod: Pod, status: PodStatus) -> Dict[str, str]:
-        """Gang coordinates for multi-host bootstrap (parallel.distributed):
-        rank = number of groupmates placed before this pod."""
+        """Gang coordinates for multi-host bootstrap (parallel.distributed).
+
+        Ranks come from the group's lowest-unused-rank registry, not from
+        the bound-pod count: a recreated mid-rank member reclaims a freed
+        rank instead of duplicating a surviving peer's (ADVICE r1)."""
         if not status.pod_group:
             return {}
-        info = self.pod_groups.get(f"{pod.namespace}/{status.pod_group}")
-        size = info.head_count if info is not None else status.min_available
-        rank = self.count_bound_group_pods(
-            pod.namespace, status.pod_group, exclude_key=pod.key
-        )
+        key = f"{pod.namespace}/{status.pod_group}"
+        info = self.pod_groups.get(key)
+        if info is None:
+            info = self.pod_groups.get_or_create(
+                pod, self.clock.now(), parse_priority(pod)
+            )
+        size = info.head_count if info.key else status.min_available
+        rank = self.pod_groups.assign_rank(key, pod.key)
         from ..parallel.distributed import (
             ENV_GANG_NAME,
             ENV_GANG_RANK,
@@ -686,6 +692,8 @@ class KubeShareScheduler:
         group = status.pod_group if status else pod.labels.get(constants.POD_GROUP_NAME, "")
         if group:
             key = f"{pod.namespace}/{group}"
+            # free the gang rank so a recreated member can reuse it
+            self.pod_groups.release_rank(key, pod.key)
             # live members = non-failed group pods excluding this one
             pods = self.cluster.list_pods(
                 namespace=pod.namespace,
@@ -722,6 +730,7 @@ class KubeShareScheduler:
         status.node_name = pod.node_name
         if not status.cells:
             self._rebind_cells_from_annotations(pod, status, memory)
+        self._recover_gang_rank(pod, status)
         if not status.is_multi_chip:
             try:
                 port = int(pod.annotations.get(constants.POD_MANAGER_PORT, ""))
@@ -733,6 +742,29 @@ class KubeShareScheduler:
                 self._port_bitmap(pod.node_name).mask(
                     port - constants.POD_MANAGER_PORT_START
                 )
+
+    def _recover_gang_rank(self, pod: Pod, status: PodStatus) -> None:
+        """Restart recovery: a bound gang pod carries its rank in container
+        env — re-register it so later recreations don't collide with it."""
+        if not status.pod_group:
+            return
+        from ..parallel.distributed import ENV_GANG_RANK
+
+        for container in pod.containers:
+            raw = container.env.get(ENV_GANG_RANK)
+            if raw is None:
+                continue
+            try:
+                rank = int(raw)
+            except ValueError:
+                return
+            key = f"{pod.namespace}/{status.pod_group}"
+            if self.pod_groups.get(key) is None:
+                self.pod_groups.get_or_create(
+                    pod, self.clock.now(), parse_priority(pod)
+                )
+            self.pod_groups.assign_rank(key, pod.key, rank=rank)
+            return
 
     def _rebind_cells_from_annotations(
         self, pod: Pod, status: PodStatus, memory: int

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import math
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, Optional, Tuple
 
 from .. import constants
@@ -56,6 +56,11 @@ class PodGroupInfo:
     head_count: int
     threshold: float
     deletion_timestamp: Optional[float] = None
+    # pod key -> gang rank.  Ranks are stable for a pod's lifetime and a
+    # recreated member takes the lowest *unused* rank, so a mid-rank
+    # restart never duplicates a surviving peer's TPUSHARE_GANG_RANK
+    # (jax.distributed process_id must be unique per gang).
+    assigned_ranks: Dict[str, int] = field(default_factory=dict)
 
 
 class PodGroupRegistry:
@@ -112,3 +117,40 @@ class PodGroupRegistry:
     def get(self, key: str) -> Optional[PodGroupInfo]:
         with self._lock:
             return self._groups.get(key)
+
+    def assign_rank(self, key: str, pod_key: str, rank: Optional[int] = None) -> int:
+        """Lowest-unused-rank assignment (idempotent per pod).  ``rank``
+        pins an explicit value — used by restart recovery to re-register
+        the rank already stamped into a bound pod's env.  A stamped rank is
+        authoritative: if a dynamically-assigned pod already took it (its
+        node's recovery had not run yet), that pod is evicted to the next
+        unused rank."""
+        with self._lock:
+            info = self._groups.get(key)
+            if info is None:
+                return 0
+            existing = info.assigned_ranks.get(pod_key)
+            if existing is not None and rank is None:
+                return existing
+            if rank is None:
+                used = set(info.assigned_ranks.values())
+                rank = next(r for r in range(len(used) + 1) if r not in used)
+            else:
+                holder = next(
+                    (k for k, r in info.assigned_ranks.items()
+                     if r == rank and k != pod_key),
+                    None,
+                )
+                if holder is not None:
+                    used = set(info.assigned_ranks.values()) | {rank}
+                    info.assigned_ranks[holder] = next(
+                        r for r in range(len(used) + 1) if r not in used
+                    )
+            info.assigned_ranks[pod_key] = rank
+            return rank
+
+    def release_rank(self, key: str, pod_key: str) -> None:
+        with self._lock:
+            info = self._groups.get(key)
+            if info is not None:
+                info.assigned_ranks.pop(pod_key, None)

@@ -257,6 +257,26 @@ class TestDecoding:
         np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
         assert (np.asarray(out1) >= 0).all() and (np.asarray(out1) < 64).all()
 
+    def test_sliding_window_prefill_matches_dense(self):
+        """A windowed model must decode with the same band the dense mask
+        keeps (ADVICE r1: cached path used to attend over full history)."""
+        from dataclasses import replace
+
+        from kubeshare_tpu.models.decoding import prefill
+
+        config, params = self._setup()
+        config = replace(config, attention_window=4)
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 12), 0, 64)
+        dense = transformer_apply(params, prompt, config)
+        _, last_logits = prefill(params, config, prompt)
+        np.testing.assert_allclose(
+            np.asarray(dense[:, -1]), np.asarray(last_logits),
+            rtol=2e-4, atol=2e-4,
+        )
+        # and it must differ from the un-windowed decode (mask is live)
+        _, full_logits = prefill(params, replace(config, attention_window=None), prompt)
+        assert not np.allclose(np.asarray(last_logits), np.asarray(full_logits))
+
     def test_overflow_guards(self):
         from kubeshare_tpu.models.decoding import greedy_decode, prefill
 

@@ -69,10 +69,11 @@ def shared_pod(name, request="0.5", limit="1.0", mem=None, priority=None, model=
                scheduler_name=constants.SCHEDULER_NAME)
 
 
-def make_env(nodes=("host-a", "host-b", "host-c"), bind_mode="patch"):
-    cluster = FakeCluster()
-    for n in nodes:
-        cluster.add_node(Node(name=n, labels={constants.NODE_LABEL_FILTER: "true"}))
+def make_env(nodes=("host-a", "host-b", "host-c"), bind_mode="patch", cluster=None):
+    if cluster is None:
+        cluster = FakeCluster()
+        for n in nodes:
+            cluster.add_node(Node(name=n, labels={constants.NODE_LABEL_FILTER: "true"}))
     clock = FakeClock(1000.0)
     plugin = KubeShareScheduler(
         topology=load_config(text=TOPOLOGY),
@@ -491,6 +492,66 @@ class TestGangEnv:
         engine.run_until_idle()
         env = cluster.get_pod("default", "solo").containers[0].env
         assert ENV_GANG_NAME not in env
+
+    def test_recreated_mid_rank_member_reuses_freed_rank(self):
+        """ADVICE r1: deleting rank-1 of a 3-gang and recreating it must
+        hand the new pod rank 1 again — not rank 2 (which would duplicate
+        a surviving peer's jax.distributed process_id)."""
+        from kubeshare_tpu.parallel.distributed import ENV_GANG_RANK
+
+        cluster, plugin, engine, _ = make_env()
+        for i in range(3):
+            cluster.create_pod(
+                shared_pod(f"w{i}", request="0.5", limit="1.0",
+                           group="ddp", headcount=3, threshold=1.0)
+            )
+        engine.run_until_idle()
+        rank_of = {
+            f"w{i}": cluster.get_pod("default", f"w{i}").containers[0].env[ENV_GANG_RANK]
+            for i in range(3)
+        }
+        victim = next(name for name, r in rank_of.items() if r == "1")
+        survivors = {r for name, r in rank_of.items() if name != victim}
+        cluster.delete_pod("default", victim)
+        cluster.create_pod(
+            shared_pod("w-new", request="0.5", limit="1.0",
+                       group="ddp", headcount=3, threshold=1.0)
+        )
+        engine.run_until_idle()
+        new_rank = cluster.get_pod("default", "w-new").containers[0].env[ENV_GANG_RANK]
+        assert new_rank == "1"
+        assert new_rank not in survivors
+
+    def test_recovered_bound_pod_pins_its_stamped_rank(self):
+        """Scheduler restart: a bound gang pod's env rank is re-registered,
+        so a later recreation of another member can't collide with it."""
+        from kubeshare_tpu.parallel.distributed import ENV_GANG_RANK
+
+        cluster, plugin, engine, _ = make_env()
+        for i in range(2):
+            cluster.create_pod(
+                shared_pod(f"r{i}", request="0.5", limit="1.0",
+                           group="gg2", headcount=2, threshold=1.0)
+            )
+        engine.run_until_idle()
+        # simulate restart: fresh plugin+engine over the same cluster state;
+        # recovery happens on the next Filter pass (ref pod.go:528-582), so
+        # schedule one new pod to trigger it
+        cluster2, plugin2, engine2, _ = make_env(cluster=cluster)
+        cluster2.create_pod(shared_pod("trigger", request="0.1", limit="1.0"))
+        engine2.run_until_idle()
+        info = plugin2.pod_groups.get("default/gg2")
+        assert info is not None
+        got = {
+            key: rank for key, rank in info.assigned_ranks.items()
+        }
+        expected = {
+            f"default/r{i}": int(
+                cluster.get_pod("default", f"r{i}").containers[0].env[ENV_GANG_RANK]
+            )
+            for i in range(2)
+        }
+        assert got == expected
 
 
 class TestDistributedSpec:
