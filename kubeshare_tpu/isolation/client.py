@@ -55,11 +55,21 @@ class TokenClient:
 
     # -- protocol ------------------------------------------------------
     def acquire(self, est_ms: float = 0.0) -> float:
-        """Block until granted a compute token; returns the quota in ms."""
-        reply = self._round_trip(f"REQ {self.pod_name} {est_ms:.3f}\n")
-        if not reply.startswith("TOK "):
+        """Poll until granted a compute token; returns the quota in ms.
+
+        The broker answers ``TOK <quota>`` or ``WAIT <retry_ms>`` (REQ is
+        non-blocking server-side; see native/tokend.cc protocol notes) —
+        the wait loop lives in the client."""
+        import time
+
+        while True:
+            reply = self._round_trip(f"REQ {self.pod_name} {est_ms:.3f}\n")
+            if reply.startswith("TOK "):
+                return float(reply[4:])
+            if reply.startswith("WAIT "):
+                time.sleep(min(0.1, max(0.001, float(reply[5:]) / 1e3)))
+                continue
             raise ConnectionError(f"unexpected token reply: {reply!r}")
-        return float(reply[4:])
 
     def release(self, used_ms: float) -> None:
         self._round_trip(f"RET {self.pod_name} {used_ms:.3f}\n")

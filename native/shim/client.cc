@@ -5,6 +5,18 @@
 // the PJRT interposer (libtpushim.so.1), from Python via ctypes (in-process
 // JAX gating, no LD_PRELOAD needed), and from tests.
 //
+// One connection, short round trips only.  REQ is non-blocking at the
+// broker ("TOK <quota>" or "WAIT <retry_ms>"); the wait loop lives HERE,
+// sleeping between polls with the connection mutex released.  That matters
+// because with completion-time charging tpushare_release() is called from
+// the runtime's event-callback thread: it interleaves freely between REQ
+// polls instead of queueing behind a server-side blocked REQ (which, in
+// the broker's exclusive mode, would deadlock — the REQ waits on the very
+// RET parked behind it).  One connection also keeps the broker's
+// per-connection grant ledger exact (every REQ's RET arrives on the same
+// connection, so a died client's outstanding grants — and only those — are
+// abandoned).
+//
 // Endpoint resolution (tpushare_init_from_env):
 //   POD_MANAGER_PORT          broker port (scheduler-injected)
 //   POD_NAME                  "<ns>/<name>" (scheduler-injected)
@@ -19,11 +31,13 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <chrono>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <mutex>
 #include <string>
+#include <thread>
 
 namespace {
 
@@ -79,8 +93,10 @@ struct Client {
     }
   }
 
-  // one request/reply round trip with a single reconnect attempt
+  // one request/reply round trip with a single reconnect attempt; takes
+  // and releases the mutex so callers can interleave between round trips
   bool RoundTrip(const std::string& request, std::string* reply) {
+    std::lock_guard<std::mutex> lock(mu);
     for (int attempt = 0; attempt < 2; attempt++) {
       if (!Connect()) return false;
       if (SendLine(request) && RecvLine(reply)) return true;
@@ -93,6 +109,12 @@ struct Client {
 Client* g_client() {
   static Client c;
   return &c;
+}
+
+std::string PodName() {
+  Client* c = g_client();
+  std::lock_guard<std::mutex> lock(c->mu);
+  return c->pod;
 }
 
 }  // namespace
@@ -143,38 +165,45 @@ int tpushare_connected(void) {
   return c->fd >= 0 ? 1 : 0;
 }
 
-// Blocks until a token is granted; returns quota_ms, or <0 on error.
+// Polls until a token is granted; returns quota_ms, or <0 on error.
+// The mutex is released while sleeping between WAIT polls.
 double tpushare_acquire(double est_ms) {
-  Client* c = g_client();
-  std::lock_guard<std::mutex> lock(c->mu);
-  std::string reply;
+  std::string pod = PodName();
   char req[160];
-  std::snprintf(req, sizeof(req), "REQ %s %.3f\n", c->pod.c_str(), est_ms);
-  if (!c->RoundTrip(req, &reply)) return -1.0;
-  if (reply.rfind("TOK ", 0) != 0) return -2.0;
-  return std::atof(reply.c_str() + 4);
+  std::snprintf(req, sizeof(req), "REQ %s %.3f\n", pod.c_str(), est_ms);
+  std::string reply;
+  while (true) {
+    if (!g_client()->RoundTrip(req, &reply)) return -1.0;
+    if (reply.rfind("TOK ", 0) == 0) return std::atof(reply.c_str() + 4);
+    if (reply.rfind("WAIT ", 0) == 0) {
+      double hint_ms = std::atof(reply.c_str() + 5);
+      if (hint_ms < 1.0) hint_ms = 1.0;
+      if (hint_ms > 100.0) hint_ms = 100.0;
+      std::this_thread::sleep_for(
+          std::chrono::microseconds(static_cast<long>(hint_ms * 1000)));
+      continue;
+    }
+    return -2.0;
+  }
 }
 
 // Reports measured device time for the held token; 0 on success.
 int tpushare_release(double used_ms) {
-  Client* c = g_client();
-  std::lock_guard<std::mutex> lock(c->mu);
   std::string reply;
   char req[160];
-  std::snprintf(req, sizeof(req), "RET %s %.3f\n", c->pod.c_str(), used_ms);
-  if (!c->RoundTrip(req, &reply)) return -1;
+  std::snprintf(req, sizeof(req), "RET %s %.3f\n", PodName().c_str(), used_ms);
+  if (!g_client()->RoundTrip(req, &reply)) return -1;
   return reply == "OK" ? 0 : -2;
 }
 
 // Accounts a memory delta against the pod's HBM cap.
 // Returns 1 granted, 0 denied, <0 error.
 int tpushare_mem_request(long long delta_bytes) {
-  Client* c = g_client();
-  std::lock_guard<std::mutex> lock(c->mu);
   std::string reply;
   char req[160];
-  std::snprintf(req, sizeof(req), "MEM %s %lld\n", c->pod.c_str(), delta_bytes);
-  if (!c->RoundTrip(req, &reply)) return -1;
+  std::snprintf(req, sizeof(req), "MEM %s %lld\n", PodName().c_str(),
+                delta_bytes);
+  if (!g_client()->RoundTrip(req, &reply)) return -1;
   if (reply.rfind("OK", 0) == 0) return 1;
   if (reply.rfind("DENY", 0) == 0) return 0;
   return -2;

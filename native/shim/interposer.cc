@@ -5,24 +5,47 @@
 // same way).  Where Gemini intercepted CUDA driver calls before each kernel
 // launch, XLA launches whole compiled programs, so the interception point is
 // PJRT_LoadedExecutable_Execute: acquire a time-quota token from the pod
-// broker, run the execution, report measured wall time (SURVEY §7.2).
+// broker, run the execution, report measured *device* time (SURVEY §7.2).
 //
 // Two hook paths cover how runtimes load libtpu:
 //  1. direct linking: our exported GetPjrtApi shadows the real one,
 //  2. dlopen+dlsym (JAX, PyTorch/XLA): we interpose dlsym and rewrite
 //     lookups of "GetPjrtApi" (Gemini hooked cuGetProcAddress likewise).
 //
-// The PJRT_Api table is copied and the Execute pointer swapped; a
-// struct_size check skips hooking when the runtime's API is older than the
-// header we compiled against.  Python/JAX deployments can skip LD_PRELOAD
-// entirely and use the in-process ctypes guard (kubeshare_tpu.isolation).
+// Enforcement semantics:
+//  * Compute time is charged completion-to-completion: Execute registers an
+//    OnReady callback on the execution's device_complete_event and charges
+//    ready_time - max(dispatch_start, previous_ready) — the device-occupancy
+//    span — not the dispatch wall time, which on async runtimes acks in
+//    microseconds regardless of FLOPs.  Falls back to dispatch wall time
+//    when the runtime offers no events.
+//  * HBM caps are enforced HARD by default: an over-cap upload returns a
+//    fabricated RESOURCE_EXHAUSTED PJRT_Error without reaching the real
+//    plugin (Gemini rejected over-cap cuMemAlloc the same way).  Set
+//    TPUSHARE_MEM_ENFORCE=soft for log-and-account-only.
+//  * Accounting is symmetric: only buffers this shim charged are credited
+//    back on destroy, by exactly the charged amount — executable outputs
+//    and device-to-device copies never drift the ledger.
+//
+// The PJRT_Api table is copied and entry pointers swapped; a struct_size
+// check skips hooking when the runtime's API is older than the header we
+// compiled against.  Only the first plugin's table is wrapped — a second
+// distinct plugin resolved through the same process passes through unhooked
+// (fractional pods get exactly one visible TPU plugin).  Python/JAX
+// deployments can skip LD_PRELOAD entirely and use the in-process ctypes
+// guard (kubeshare_tpu.isolation).
 
 #include <dlfcn.h>
 
 #include <chrono>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <mutex>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
 
 #include "xla/pjrt/c/pjrt_c_api.h"
 
@@ -41,23 +64,87 @@ PJRT_Error* (*g_real_execute)(PJRT_LoadedExecutable_Execute_Args*) = nullptr;
 PJRT_Error* (*g_real_buffer_from_host)(PJRT_Client_BufferFromHostBuffer_Args*) =
     nullptr;
 PJRT_Error* (*g_real_buffer_destroy)(PJRT_Buffer_Destroy_Args*) = nullptr;
-PJRT_Error* (*g_real_buffer_on_device_size)(
-    PJRT_Buffer_OnDeviceSizeInBytes_Args*) = nullptr;
-bool g_gated = false;
-double g_estimate_ms = 1.0;  // EMA of observed execution wall time
+void (*g_real_error_destroy)(PJRT_Error_Destroy_Args*) = nullptr;
+void (*g_real_error_message)(PJRT_Error_Message_Args*) = nullptr;
+PJRT_Error* (*g_real_error_get_code)(PJRT_Error_GetCode_Args*) = nullptr;
+PJRT_Error* (*g_real_event_on_ready)(PJRT_Event_OnReady_Args*) = nullptr;
+PJRT_Error* (*g_real_event_destroy)(PJRT_Event_Destroy_Args*) = nullptr;
 
-double NowMs() {
-  return std::chrono::duration<double, std::milli>(
-             std::chrono::steady_clock::now().time_since_epoch())
-      .count();
+bool g_gated = false;
+bool g_mem_soft = false;
+
+// ---------------------------------------------------------------------------
+// Fabricated errors.  PJRT_Error is plugin-opaque, so we mint our own
+// objects and service the three error entry points for them, forwarding
+// everything else to the real plugin.
+// ---------------------------------------------------------------------------
+
+struct ShimError {
+  std::string message;
+  PJRT_Error_Code code;
+};
+
+std::mutex g_error_mu;
+std::set<const void*>& ShimErrors() {
+  static std::set<const void*> errors;
+  return errors;
 }
 
-// HBM accounting: charge host->device uploads against the pod's cap via
-// the broker's MEM protocol and credit them back on buffer destruction.
-// Over-cap allocations are logged (soft enforcement; the scheduler already
-// guarantees placement-time fit — this catches misbehaving pods for the
-// operator, with hard denial a follow-up once PJRT error fabrication is
-// plumbed).
+PJRT_Error* MakeShimError(PJRT_Error_Code code, std::string message) {
+  auto* error = new ShimError{std::move(message), code};
+  std::lock_guard<std::mutex> lock(g_error_mu);
+  ShimErrors().insert(error);
+  return reinterpret_cast<PJRT_Error*>(error);
+}
+
+ShimError* AsShimError(const PJRT_Error* error) {
+  std::lock_guard<std::mutex> lock(g_error_mu);
+  if (ShimErrors().count(error) == 0) return nullptr;
+  return reinterpret_cast<ShimError*>(const_cast<PJRT_Error*>(error));
+}
+
+void HookedErrorDestroy(PJRT_Error_Destroy_Args* args) {
+  if (args->error != nullptr) {
+    std::lock_guard<std::mutex> lock(g_error_mu);
+    auto it = ShimErrors().find(args->error);
+    if (it != ShimErrors().end()) {
+      ShimErrors().erase(it);
+      delete reinterpret_cast<ShimError*>(args->error);
+      return;
+    }
+  }
+  if (g_real_error_destroy != nullptr) g_real_error_destroy(args);
+}
+
+void HookedErrorMessage(PJRT_Error_Message_Args* args) {
+  if (ShimError* shim = AsShimError(args->error)) {
+    args->message = shim->message.c_str();
+    args->message_size = shim->message.size();
+    return;
+  }
+  if (g_real_error_message != nullptr) g_real_error_message(args);
+}
+
+PJRT_Error* HookedErrorGetCode(PJRT_Error_GetCode_Args* args) {
+  if (ShimError* shim = AsShimError(args->error)) {
+    args->code = shim->code;
+    return nullptr;
+  }
+  if (g_real_error_get_code != nullptr) return g_real_error_get_code(args);
+  return nullptr;
+}
+
+// ---------------------------------------------------------------------------
+// HBM accounting: charge host->device uploads against the pod's cap via the
+// broker's MEM protocol; credit exactly the charged amount on destroy.
+// ---------------------------------------------------------------------------
+
+std::mutex g_mem_mu;
+std::unordered_map<const void*, long long>& ChargedBuffers() {
+  static std::unordered_map<const void*, long long> charged;
+  return charged;
+}
+
 long long ElementBytes(PJRT_Buffer_Type type) {
   switch (type) {
     case PJRT_Buffer_Type_PRED:
@@ -82,82 +169,272 @@ long long ElementBytes(PJRT_Buffer_Type type) {
 }
 
 PJRT_Error* HookedBufferFromHost(PJRT_Client_BufferFromHostBuffer_Args* args) {
-  if (g_gated && args->dims != nullptr) {
-    long long elements = 1;
-    for (size_t i = 0; i < args->num_dims; i++) elements *= args->dims[i];
-    long long bytes = elements * ElementBytes(args->type);
-    if (tpushare_mem_request(bytes) == 0) {
-      std::fprintf(stderr,
-                   "tpushim: HBM cap exceeded by %lld-byte upload "
-                   "(soft-deny; accounted)\n", bytes);
+  if (!g_gated || args->dims == nullptr) return g_real_buffer_from_host(args);
+  long long elements = 1;
+  for (size_t i = 0; i < args->num_dims; i++) elements *= args->dims[i];
+  long long bytes = elements * ElementBytes(args->type);
+  int rc = tpushare_mem_request(bytes);
+  bool charged = rc > 0;
+  if (rc == 0) {  // broker said DENY; rc<0 (broker gone) fails open
+    if (!g_mem_soft) {
+      char msg[160];
+      std::snprintf(msg, sizeof(msg),
+                    "tpushare: HBM cap exceeded: %lld-byte host-to-device "
+                    "upload denied (pod over its gpu_mem cap)",
+                    bytes);
+      std::fprintf(stderr, "tpushim: %s\n", msg);
+      return MakeShimError(PJRT_Error_Code_RESOURCE_EXHAUSTED, msg);
     }
+    std::fprintf(stderr,
+                 "tpushim: HBM cap exceeded by %lld-byte upload "
+                 "(soft mode; not denied)\n", bytes);
   }
-  return g_real_buffer_from_host(args);
+  PJRT_Error* err = g_real_buffer_from_host(args);
+  if (err == nullptr && charged && args->buffer != nullptr) {
+    std::lock_guard<std::mutex> lock(g_mem_mu);
+    ChargedBuffers()[args->buffer] += bytes;
+  } else if (err != nullptr && charged) {
+    tpushare_mem_request(-bytes);  // upload failed: roll the charge back
+  }
+  return err;
 }
 
 PJRT_Error* HookedBufferDestroy(PJRT_Buffer_Destroy_Args* args) {
-  if (g_gated && g_real_buffer_on_device_size != nullptr) {
-    PJRT_Buffer_OnDeviceSizeInBytes_Args size_args;
-    std::memset(&size_args, 0, sizeof(size_args));
-    size_args.struct_size = PJRT_Buffer_OnDeviceSizeInBytes_Args_STRUCT_SIZE;
-    size_args.buffer = args->buffer;
-    PJRT_Error* err = g_real_buffer_on_device_size(&size_args);
-    if (err == nullptr && size_args.on_device_size_in_bytes > 0) {
-      tpushare_mem_request(
-          -static_cast<long long>(size_args.on_device_size_in_bytes));
+  if (g_gated && args->buffer != nullptr) {
+    long long credit = 0;
+    {
+      std::lock_guard<std::mutex> lock(g_mem_mu);
+      auto it = ChargedBuffers().find(args->buffer);
+      if (it != ChargedBuffers().end()) {
+        credit = it->second;
+        ChargedBuffers().erase(it);
+      }
     }
+    // credit only what we charged: buffers we never saw (executable
+    // outputs, device-to-device copies) must not drift usage toward zero
+    if (credit > 0) tpushare_mem_request(-credit);
   }
   return g_real_buffer_destroy(args);
 }
 
+// ---------------------------------------------------------------------------
+// Execute: token-gated, charged by device completion time.
+// ---------------------------------------------------------------------------
+
+double NowMs() {
+  return std::chrono::duration<double, std::milli>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+std::mutex g_charge_mu;
+double g_estimate_ms = 1.0;       // EMA of observed device time (estimate only)
+double g_last_complete_ms = 0.0;  // completion-to-completion charging anchor
+
+// Events we own whose callbacks have fired; destroyed on the next Execute
+// (never from inside the plugin's callback thread).
+std::vector<PJRT_Event*>& RetiredEvents() {
+  static std::vector<PJRT_Event*> retired;
+  return retired;
+}
+
+void DrainRetiredEventsLocked() {
+  std::vector<PJRT_Event*> retired;
+  {
+    std::lock_guard<std::mutex> lock(g_charge_mu);
+    retired.swap(RetiredEvents());
+  }
+  for (PJRT_Event* event : retired) {
+    PJRT_Event_Destroy_Args destroy_args;
+    std::memset(&destroy_args, 0, sizeof(destroy_args));
+    destroy_args.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+    destroy_args.event = event;
+    PJRT_Error* err = g_real_event_destroy(&destroy_args);
+    if (err != nullptr && g_real_error_destroy != nullptr) {
+      PJRT_Error_Destroy_Args err_args;
+      std::memset(&err_args, 0, sizeof(err_args));
+      err_args.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+      err_args.error = err;
+      g_real_error_destroy(&err_args);
+    }
+  }
+}
+
+void ChargeCompletion(double start_ms, double ready_ms) {
+  double charged;
+  {
+    std::lock_guard<std::mutex> lock(g_charge_mu);
+    double base = g_last_complete_ms > start_ms ? g_last_complete_ms : start_ms;
+    charged = ready_ms - base;
+    if (charged < 0.0) charged = 0.0;
+    if (ready_ms > g_last_complete_ms) g_last_complete_ms = ready_ms;
+    g_estimate_ms = 0.8 * g_estimate_ms + 0.2 * charged;
+  }
+  tpushare_release(charged);
+}
+
+struct ExecCharge {
+  double start_ms;
+  PJRT_Event* event;
+  bool owned;    // we allocated the event (caller passed no events array)
+  bool primary;  // device 0 carries the charge for the execution
+};
+
+void OnExecuteComplete(PJRT_Error* error, void* user_arg) {
+  auto* charge = static_cast<ExecCharge*>(user_arg);
+  if (error != nullptr && g_real_error_destroy != nullptr) {
+    PJRT_Error_Destroy_Args err_args;
+    std::memset(&err_args, 0, sizeof(err_args));
+    err_args.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    err_args.error = error;
+    g_real_error_destroy(&err_args);
+  }
+  if (charge->primary) ChargeCompletion(charge->start_ms, NowMs());
+  if (charge->owned) {
+    std::lock_guard<std::mutex> lock(g_charge_mu);
+    RetiredEvents().push_back(charge->event);
+  }
+  delete charge;
+}
+
 PJRT_Error* HookedExecute(PJRT_LoadedExecutable_Execute_Args* args) {
   if (!g_gated) return g_real_execute(args);
-  tpushare_acquire(g_estimate_ms);
+  double estimate;
+  {
+    std::lock_guard<std::mutex> lock(g_charge_mu);
+    estimate = g_estimate_ms;
+  }
+  tpushare_acquire(estimate);
+  DrainRetiredEventsLocked();
+
+  // ask the plugin for completion events when the caller didn't
+  bool events_usable =
+      g_real_event_on_ready != nullptr && g_real_event_destroy != nullptr &&
+      args->struct_size >= PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE &&
+      args->num_devices >= 1;
+  std::vector<PJRT_Event*> own_events;
+  bool own = false;
+  if (events_usable && args->device_complete_events == nullptr) {
+    own_events.assign(args->num_devices, nullptr);
+    args->device_complete_events = own_events.data();
+    own = true;
+  }
+
   double start = NowMs();
   PJRT_Error* err = g_real_execute(args);
-  // Execution may complete asynchronously; the dispatch+completion wait we
-  // can observe here is the lower bound and the EMA tracks the real burst
-  // cost across steps (SURVEY §7.4's execution-granularity caveat).
-  double elapsed = NowMs() - start;
-  g_estimate_ms = 0.8 * g_estimate_ms + 0.2 * elapsed;
-  tpushare_release(elapsed);
+  double dispatch_end = NowMs();
+
+  if (err != nullptr && own) {
+    // per spec the plugin does not populate events on error, but a plugin
+    // that filled some before failing must not leak them
+    for (size_t i = 0; i < args->num_devices; i++) {
+      if (own_events[i] != nullptr) {
+        std::lock_guard<std::mutex> lock(g_charge_mu);
+        RetiredEvents().push_back(own_events[i]);
+      }
+    }
+  }
+
+  bool charged_async = false;
+  if (err == nullptr && events_usable &&
+      args->device_complete_events != nullptr) {
+    for (size_t i = 0; i < args->num_devices; i++) {
+      PJRT_Event* event = args->device_complete_events[i];
+      if (event == nullptr) continue;
+      auto* charge = new ExecCharge{start, event, own, i == 0};
+      PJRT_Event_OnReady_Args ready_args;
+      std::memset(&ready_args, 0, sizeof(ready_args));
+      ready_args.struct_size = PJRT_Event_OnReady_Args_STRUCT_SIZE;
+      ready_args.event = event;
+      ready_args.callback = OnExecuteComplete;
+      ready_args.user_arg = charge;
+      PJRT_Error* ready_err = g_real_event_on_ready(&ready_args);
+      if (ready_err != nullptr) {
+        if (g_real_error_destroy != nullptr) {
+          PJRT_Error_Destroy_Args err_args;
+          std::memset(&err_args, 0, sizeof(err_args));
+          err_args.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+          err_args.error = ready_err;
+          g_real_error_destroy(&err_args);
+        }
+        delete charge;
+        if (own) {
+          std::lock_guard<std::mutex> lock(g_charge_mu);
+          RetiredEvents().push_back(event);
+        }
+        continue;
+      }
+      if (i == 0) charged_async = true;
+    }
+  }
+  if (own) args->device_complete_events = nullptr;  // restore caller's view
+
+  if (!charged_async) {
+    // no events available (old runtime / execute error): dispatch wall time
+    // is the only observable — the documented lower bound
+    double elapsed = dispatch_end - start;
+    {
+      std::lock_guard<std::mutex> lock(g_charge_mu);
+      g_estimate_ms = 0.8 * g_estimate_ms + 0.2 * elapsed;
+    }
+    tpushare_release(elapsed);
+  }
   return err;
 }
 
+// ---------------------------------------------------------------------------
+// API table wrapping.
+// ---------------------------------------------------------------------------
+
 const PJRT_Api* WrapApi(const PJRT_Api* real) {
+  static std::mutex mu;
+  static const PJRT_Api* wrapped_source = nullptr;
   static PJRT_Api wrapped;
-  static std::once_flag once;
-  static const PJRT_Api* result = nullptr;
-  std::call_once(once, [&] {
-    if (real == nullptr) return;
-    if (real->struct_size < PJRT_Api_STRUCT_SIZE) {
-      // runtime older than our header: pass through unhooked
-      std::fprintf(stderr,
-                   "tpushim: PJRT api struct too small (%zu), not gating\n",
-                   real->struct_size);
-      result = real;
-      return;
-    }
-    std::memcpy(&wrapped, real, sizeof(PJRT_Api));
-    g_real_execute = wrapped.PJRT_LoadedExecutable_Execute;
-    wrapped.PJRT_LoadedExecutable_Execute = HookedExecute;
-    g_real_buffer_from_host = wrapped.PJRT_Client_BufferFromHostBuffer;
-    g_real_buffer_destroy = wrapped.PJRT_Buffer_Destroy;
-    g_real_buffer_on_device_size = wrapped.PJRT_Buffer_OnDeviceSizeInBytes;
-    if (g_real_buffer_from_host != nullptr) {
-      wrapped.PJRT_Client_BufferFromHostBuffer = HookedBufferFromHost;
-    }
-    if (g_real_buffer_destroy != nullptr) {
-      wrapped.PJRT_Buffer_Destroy = HookedBufferDestroy;
-    }
-    g_gated = tpushare_init_from_env() == 0;
-    if (!g_gated) {
-      std::fprintf(stderr,
-                   "tpushim: no POD_MANAGER_PORT, running ungated\n");
-    }
-    result = &wrapped;
-  });
-  return result != nullptr ? result : real;
+  if (real == nullptr) return nullptr;
+  std::lock_guard<std::mutex> lock(mu);
+  if (wrapped_source == real) return &wrapped;
+  if (wrapped_source != nullptr) {
+    // a second distinct plugin in this process: pass through unhooked
+    // rather than misrouting its calls into the first plugin's table
+    std::fprintf(stderr,
+                 "tpushim: additional PJRT plugin detected, not gating it\n");
+    return real;
+  }
+  if (real->struct_size < PJRT_Api_STRUCT_SIZE) {
+    // runtime older than our header: pass through unhooked
+    std::fprintf(stderr,
+                 "tpushim: PJRT api struct too small (%zu), not gating\n",
+                 real->struct_size);
+    return real;
+  }
+  std::memcpy(&wrapped, real, sizeof(PJRT_Api));
+  g_real_execute = wrapped.PJRT_LoadedExecutable_Execute;
+  wrapped.PJRT_LoadedExecutable_Execute = HookedExecute;
+  g_real_buffer_from_host = wrapped.PJRT_Client_BufferFromHostBuffer;
+  g_real_buffer_destroy = wrapped.PJRT_Buffer_Destroy;
+  g_real_error_destroy = wrapped.PJRT_Error_Destroy;
+  g_real_error_message = wrapped.PJRT_Error_Message;
+  g_real_error_get_code = wrapped.PJRT_Error_GetCode;
+  g_real_event_on_ready = wrapped.PJRT_Event_OnReady;
+  g_real_event_destroy = wrapped.PJRT_Event_Destroy;
+  if (g_real_buffer_from_host != nullptr) {
+    wrapped.PJRT_Client_BufferFromHostBuffer = HookedBufferFromHost;
+  }
+  if (g_real_buffer_destroy != nullptr) {
+    wrapped.PJRT_Buffer_Destroy = HookedBufferDestroy;
+  }
+  // fabricated-error service entries (pass-through for real errors)
+  wrapped.PJRT_Error_Destroy = HookedErrorDestroy;
+  wrapped.PJRT_Error_Message = HookedErrorMessage;
+  wrapped.PJRT_Error_GetCode = HookedErrorGetCode;
+  const char* mode = std::getenv("TPUSHARE_MEM_ENFORCE");
+  g_mem_soft = mode != nullptr && std::strcmp(mode, "soft") == 0;
+  g_gated = tpushare_init_from_env() == 0;
+  if (!g_gated) {
+    std::fprintf(stderr, "tpushim: no POD_MANAGER_PORT, running ungated\n");
+  }
+  wrapped_source = real;
+  return &wrapped;
 }
 
 GetPjrtApiFn RealGetPjrtApi() {

@@ -1,26 +1,202 @@
-// Test fixture: a minimal PJRT plugin exporting GetPjrtApi with a live
-// Execute entry, used to verify the libtpushim interposer end-to-end
-// without TPU hardware (tests/test_native_runtime.py::TestInterposer).
+// Test fixture: a minimal PJRT plugin exporting GetPjrtApi with live
+// Execute / buffer / error / event entries, used to verify the libtpushim
+// interposer end-to-end without TPU hardware
+// (tests/test_native_runtime.py::TestInterposer).
+//
+// FAKE_DEVICE_MS=<n> makes each Execute's device_complete_event fire n ms
+// after dispatch on a background thread — modelling the async-dispatch gap
+// the interposer's completion-time charging must measure (dispatch returns
+// immediately; the device is busy for n ms).
 
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <utility>
+#include <vector>
 
 #include "xla/pjrt/c/pjrt_c_api.h"
 
 namespace {
 
-int g_execute_calls = 0;
-int g_buffer_calls = 0;
-int g_destroy_calls = 0;
+std::atomic<int> g_execute_calls{0};
+std::atomic<int> g_buffer_calls{0};
+std::atomic<int> g_destroy_calls{0};
+std::atomic<int> g_events_created{0};
+std::atomic<int> g_events_fired{0};
+std::atomic<int> g_events_destroyed{0};
+std::atomic<uintptr_t> g_next_handle{0x1000};
 
-PJRT_Error* FakeExecute(PJRT_LoadedExecutable_Execute_Args*) {
+int DeviceMs() {
+  static int ms = [] {
+    const char* env = std::getenv("FAKE_DEVICE_MS");
+    return env != nullptr ? std::atoi(env) : 0;
+  }();
+  return ms;
+}
+
+// ---------------------------------------------------------------------------
+// Errors: the plugin's own opaque PJRT_Error representation.
+// ---------------------------------------------------------------------------
+
+struct FakeError {
+  std::string message;
+  PJRT_Error_Code code;
+};
+
+void FakeErrorDestroy(PJRT_Error_Destroy_Args* args) {
+  delete reinterpret_cast<FakeError*>(args->error);
+}
+
+void FakeErrorMessage(PJRT_Error_Message_Args* args) {
+  auto* error = reinterpret_cast<const FakeError*>(args->error);
+  args->message = error->message.c_str();
+  args->message_size = error->message.size();
+}
+
+PJRT_Error* FakeErrorGetCode(PJRT_Error_GetCode_Args* args) {
+  args->code = reinterpret_cast<const FakeError*>(args->error)->code;
+  return nullptr;
+}
+
+// ---------------------------------------------------------------------------
+// Events: ready-flag + callback list, completed by a delayed worker thread.
+// Ref-counted so Destroy can race the completion thread safely.
+// ---------------------------------------------------------------------------
+
+struct FakeEvent {
+  std::mutex mu;
+  std::condition_variable cv;
+  bool ready = false;
+  std::vector<std::pair<PJRT_Event_OnReadyCallback, void*>> callbacks;
+  std::atomic<int> refs{1};
+
+  void Unref() {
+    if (refs.fetch_sub(1) == 1) delete this;
+  }
+
+  void Fire() {
+    std::vector<std::pair<PJRT_Event_OnReadyCallback, void*>> pending;
+    {
+      std::lock_guard<std::mutex> lock(mu);
+      ready = true;
+      pending.swap(callbacks);
+      cv.notify_all();
+    }
+    g_events_fired++;
+    for (auto& [callback, arg] : pending) callback(nullptr, arg);
+  }
+};
+
+PJRT_Error* FakeEventDestroy(PJRT_Event_Destroy_Args* args) {
+  if (args->event != nullptr) {
+    g_events_destroyed++;
+    reinterpret_cast<FakeEvent*>(args->event)->Unref();
+  }
+  return nullptr;
+}
+
+PJRT_Error* FakeEventIsReady(PJRT_Event_IsReady_Args* args) {
+  auto* event = reinterpret_cast<FakeEvent*>(args->event);
+  std::lock_guard<std::mutex> lock(event->mu);
+  args->is_ready = event->ready;
+  return nullptr;
+}
+
+PJRT_Error* FakeEventAwait(PJRT_Event_Await_Args* args) {
+  auto* event = reinterpret_cast<FakeEvent*>(args->event);
+  std::unique_lock<std::mutex> lock(event->mu);
+  event->cv.wait(lock, [event] { return event->ready; });
+  return nullptr;
+}
+
+PJRT_Error* FakeEventOnReady(PJRT_Event_OnReady_Args* args) {
+  auto* event = reinterpret_cast<FakeEvent*>(args->event);
+  bool fire_now = false;
+  {
+    std::lock_guard<std::mutex> lock(event->mu);
+    if (event->ready) {
+      fire_now = true;
+    } else {
+      event->callbacks.emplace_back(args->callback, args->user_arg);
+    }
+  }
+  if (fire_now) args->callback(nullptr, args->user_arg);
+  return nullptr;
+}
+
+// ---------------------------------------------------------------------------
+// Execute / buffers.
+// ---------------------------------------------------------------------------
+
+// The fake device: a single FIFO worker, because real hardware executes
+// dispatched programs in order — completions land at t, 2t, 3t..., which is
+// exactly what completion-to-completion charging must observe.
+struct DeviceQueue {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<FakeEvent*> fifo;
+  bool started = false;
+
+  void Push(FakeEvent* event) {
+    std::lock_guard<std::mutex> lock(mu);
+    fifo.push_back(event);
+    if (!started) {
+      started = true;
+      std::thread([this] { Run(); }).detach();
+    }
+    cv.notify_all();
+  }
+
+  void Run() {
+    while (true) {
+      FakeEvent* event;
+      {
+        std::unique_lock<std::mutex> lock(mu);
+        cv.wait(lock, [this] { return !fifo.empty(); });
+        event = fifo.front();
+        fifo.erase(fifo.begin());
+      }
+      int delay = DeviceMs();
+      if (delay > 0) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(delay));
+      }
+      event->Fire();
+      event->Unref();
+    }
+  }
+};
+
+DeviceQueue& Device() {
+  // intentionally leaked: the detached worker may still be blocked on the
+  // cv at process exit; destroying the mutex/cv under it hangs exit
+  static DeviceQueue* queue = new DeviceQueue;
+  return *queue;
+}
+
+PJRT_Error* FakeExecute(PJRT_LoadedExecutable_Execute_Args* args) {
   g_execute_calls++;
+  if (args->struct_size >= PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE &&
+      args->device_complete_events != nullptr && args->num_devices >= 1) {
+    for (size_t i = 0; i < args->num_devices; i++) {
+      auto* event = new FakeEvent;
+      g_events_created++;
+      args->device_complete_events[i] = reinterpret_cast<PJRT_Event*>(event);
+      event->refs.fetch_add(1);  // device-queue's reference
+      Device().Push(event);
+    }
+  }
   return nullptr;
 }
 
 PJRT_Error* FakeBufferFromHost(PJRT_Client_BufferFromHostBuffer_Args* args) {
   g_buffer_calls++;
-  args->buffer = reinterpret_cast<PJRT_Buffer*>(0x1);  // opaque fake handle
+  args->buffer = reinterpret_cast<PJRT_Buffer*>(g_next_handle.fetch_add(16));
   return nullptr;
 }
 
@@ -38,9 +214,12 @@ PJRT_Error* FakeOnDeviceSize(PJRT_Buffer_OnDeviceSizeInBytes_Args* args) {
 
 extern "C" {
 
-int fake_execute_calls(void) { return g_execute_calls; }
-int fake_buffer_calls(void) { return g_buffer_calls; }
-int fake_destroy_calls(void) { return g_destroy_calls; }
+int fake_execute_calls(void) { return g_execute_calls.load(); }
+int fake_buffer_calls(void) { return g_buffer_calls.load(); }
+int fake_destroy_calls(void) { return g_destroy_calls.load(); }
+int fake_events_created(void) { return g_events_created.load(); }
+int fake_events_fired(void) { return g_events_fired.load(); }
+int fake_events_destroyed(void) { return g_events_destroyed.load(); }
 
 const PJRT_Api* GetPjrtApi(void) {
   static PJRT_Api api;
@@ -50,6 +229,13 @@ const PJRT_Api* GetPjrtApi(void) {
     api.struct_size = PJRT_Api_STRUCT_SIZE;
     api.pjrt_api_version.major_version = PJRT_API_MAJOR;
     api.pjrt_api_version.minor_version = PJRT_API_MINOR;
+    api.PJRT_Error_Destroy = FakeErrorDestroy;
+    api.PJRT_Error_Message = FakeErrorMessage;
+    api.PJRT_Error_GetCode = FakeErrorGetCode;
+    api.PJRT_Event_Destroy = FakeEventDestroy;
+    api.PJRT_Event_IsReady = FakeEventIsReady;
+    api.PJRT_Event_Await = FakeEventAwait;
+    api.PJRT_Event_OnReady = FakeEventOnReady;
     api.PJRT_LoadedExecutable_Execute = FakeExecute;
     api.PJRT_Client_BufferFromHostBuffer = FakeBufferFromHost;
     api.PJRT_Buffer_Destroy = FakeBufferDestroy;

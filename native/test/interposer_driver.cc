@@ -3,21 +3,48 @@
 // returned API table.  Run with LD_PRELOAD=libtpushim.so.1 to verify the
 // interposer gates each Execute through the token runtime.
 //
-// usage: interposer_driver <plugin.so> <n_executions>
+// usage: interposer_driver <plugin.so> <n_executions> [options]
+//   --upload-bytes B   upload a B-byte f32 array (default 4096); prints
+//                      "upload_ok" or "upload_denied code=<c> msg=<m>"
+//   --keep-buffer      skip the destroy after a successful upload
+//   --events           caller-owned completion events: request
+//                      device_complete_events, await + destroy them
+//   --sleep-ms S       sleep S ms before exit (lets async completion
+//                      callbacks deliver their RET to the tokend)
 
 #include <dlfcn.h>
 
+#include <chrono>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <string>
+#include <thread>
 
 #include "xla/pjrt/c/pjrt_c_api.h"
 
 int main(int argc, char** argv) {
   if (argc < 3) {
-    std::fprintf(stderr, "usage: %s <plugin.so> <n>\n", argv[0]);
+    std::fprintf(stderr, "usage: %s <plugin.so> <n> [options]\n", argv[0]);
     return 2;
   }
+  long long upload_bytes = 4096;
+  bool keep_buffer = false;
+  bool caller_events = false;
+  int sleep_ms = 0;
+  for (int i = 3; i < argc; i++) {
+    std::string flag = argv[i];
+    if (flag == "--upload-bytes" && i + 1 < argc) {
+      upload_bytes = std::atoll(argv[++i]);
+    } else if (flag == "--keep-buffer") {
+      keep_buffer = true;
+    } else if (flag == "--events") {
+      caller_events = true;
+    } else if (flag == "--sleep-ms" && i + 1 < argc) {
+      sleep_ms = std::atoi(argv[++i]);
+    }
+  }
+
   void* handle = dlopen(argv[1], RTLD_NOW);
   if (handle == nullptr) {
     std::fprintf(stderr, "dlopen: %s\n", dlerror());
@@ -34,30 +61,95 @@ int main(int argc, char** argv) {
     std::fprintf(stderr, "no api or execute\n");
     return 1;
   }
+
   int n = std::atoi(argv[2]);
-  PJRT_LoadedExecutable_Execute_Args args;
+  int events_ready = 0;
   for (int i = 0; i < n; i++) {
+    PJRT_LoadedExecutable_Execute_Args args;
+    std::memset(&args, 0, sizeof(args));
+    args.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+    args.num_devices = 1;
+    PJRT_Event* events[1] = {nullptr};
+    if (caller_events) args.device_complete_events = events;
     api->PJRT_LoadedExecutable_Execute(&args);
+    if (caller_events && events[0] != nullptr) {
+      if (api->PJRT_Event_Await != nullptr) {
+        PJRT_Event_Await_Args await_args;
+        std::memset(&await_args, 0, sizeof(await_args));
+        await_args.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+        await_args.event = events[0];
+        api->PJRT_Event_Await(&await_args);
+        events_ready++;
+      }
+      if (api->PJRT_Event_Destroy != nullptr) {
+        PJRT_Event_Destroy_Args destroy_args;
+        std::memset(&destroy_args, 0, sizeof(destroy_args));
+        destroy_args.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+        destroy_args.event = events[0];
+        api->PJRT_Event_Destroy(&destroy_args);
+      }
+    }
   }
-  // one host->device upload of a [256, 4] f32 array (4096 bytes), destroyed
-  // again: exercises the HBM accounting hooks
+  if (caller_events) std::printf("events_ready %d\n", events_ready);
+
+  // one host->device upload of upload_bytes (f32), destroyed again unless
+  // kept: exercises the HBM accounting + hard-denial hooks
   if (api->PJRT_Client_BufferFromHostBuffer != nullptr) {
     PJRT_Client_BufferFromHostBuffer_Args buffer_args;
     std::memset(&buffer_args, 0, sizeof(buffer_args));
     buffer_args.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
-    int64_t dims[2] = {256, 4};
+    int64_t dims[1] = {upload_bytes / 4};
     buffer_args.type = PJRT_Buffer_Type_F32;
     buffer_args.dims = dims;
-    buffer_args.num_dims = 2;
-    api->PJRT_Client_BufferFromHostBuffer(&buffer_args);
-    if (api->PJRT_Buffer_Destroy != nullptr && buffer_args.buffer != nullptr) {
-      PJRT_Buffer_Destroy_Args destroy_args;
-      std::memset(&destroy_args, 0, sizeof(destroy_args));
-      destroy_args.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
-      destroy_args.buffer = buffer_args.buffer;
-      api->PJRT_Buffer_Destroy(&destroy_args);
+    buffer_args.num_dims = 1;
+    PJRT_Error* err = api->PJRT_Client_BufferFromHostBuffer(&buffer_args);
+    if (err != nullptr) {
+      PJRT_Error_Code code = PJRT_Error_Code_UNKNOWN;
+      if (api->PJRT_Error_GetCode != nullptr) {
+        PJRT_Error_GetCode_Args code_args;
+        std::memset(&code_args, 0, sizeof(code_args));
+        code_args.struct_size = PJRT_Error_GetCode_Args_STRUCT_SIZE;
+        code_args.error = err;
+        api->PJRT_Error_GetCode(&code_args);
+        code = code_args.code;
+      }
+      std::string message = "<none>";
+      if (api->PJRT_Error_Message != nullptr) {
+        PJRT_Error_Message_Args msg_args;
+        std::memset(&msg_args, 0, sizeof(msg_args));
+        msg_args.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+        msg_args.error = err;
+        api->PJRT_Error_Message(&msg_args);
+        if (msg_args.message != nullptr) {
+          message.assign(msg_args.message, msg_args.message_size);
+        }
+      }
+      std::printf("upload_denied code=%d msg=%s\n", static_cast<int>(code),
+                  message.c_str());
+      if (api->PJRT_Error_Destroy != nullptr) {
+        PJRT_Error_Destroy_Args destroy_args;
+        std::memset(&destroy_args, 0, sizeof(destroy_args));
+        destroy_args.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+        destroy_args.error = err;
+        api->PJRT_Error_Destroy(&destroy_args);
+      }
+    } else {
+      std::printf("upload_ok\n");
+      if (!keep_buffer && api->PJRT_Buffer_Destroy != nullptr &&
+          buffer_args.buffer != nullptr) {
+        PJRT_Buffer_Destroy_Args destroy_args;
+        std::memset(&destroy_args, 0, sizeof(destroy_args));
+        destroy_args.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+        destroy_args.buffer = buffer_args.buffer;
+        api->PJRT_Buffer_Destroy(&destroy_args);
+      }
     }
   }
+
+  if (sleep_ms > 0) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(sleep_ms));
+  }
+
   auto calls = reinterpret_cast<int (*)()>(dlsym(handle, "fake_execute_calls"));
   auto buffers = reinterpret_cast<int (*)()>(dlsym(handle, "fake_buffer_calls"));
   std::printf("executed %d real_calls %d buffers %d\n", n,

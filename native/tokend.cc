@@ -21,10 +21,18 @@
 // with mtime polling as fallback.
 //
 // Wire protocol (line-based TCP; pmgr proxies and stamps pod identity):
-//   REQ <pod> <est_ms>   -> TOK <quota_ms>        (blocks until granted)
+//   REQ <pod> <est_ms>   -> TOK <quota_ms> | WAIT <retry_ms>
 //   RET <pod> <used_ms>  -> OK
 //   MEM <pod> <delta>    -> OK <used> <cap> | DENY <used> <cap>
 //   STAT                 -> one JSON line
+//
+// REQ is NON-blocking: an ineligible pod gets "WAIT <retry_ms>" and polls.
+// Rationale: with completion-time charging the client's RET is sent from
+// the runtime's event-callback thread over the same connection; a
+// server-side blocking REQ would wedge that connection (in exclusive mode
+// the REQ literally waits for the RET queued behind it).  Client-side
+// polling keeps one connection per client, so the per-connection grant
+// ledger (Abandon on disconnect) pairs every REQ with its RET exactly.
 //
 // Scheduling policy, two modes:
 //
@@ -54,8 +62,8 @@
 #include <atomic>
 #include <chrono>
 #include <cmath>
-#include <condition_variable>
 #include <cstring>
+#include <deque>
 #include <fstream>
 #include <iostream>
 #include <map>
@@ -82,10 +90,19 @@ struct PodQuota {
   // accounting
   double used_ms = 0.0;     // decayed usage within the window
   double last_decay = 0.0;  // ms timestamp of last decay application
-  double grant_time = 0.0;  // ms timestamp of last token grant
-  double outstanding_quota = 0.0;
+  // FIFO of outstanding grants: Release retires the oldest, so each
+  // grant's quota AND grant timestamp travel together — a single
+  // last-grant slot would misprice pipelined grants and let a client
+  // that keeps a fresh REQ in flight collapse the anti-lying hold floor
+  struct Grant {
+    double quota;
+    double time;
+  };
+  std::deque<Grant> outstanding_quotas;
+  double charged_total_ms = 0.0;  // lifetime device-time charged (no decay)
   long long mem_used = 0;
   long long grants = 0;
+  double last_wait_poll = 0.0;  // ms timestamp of last WAITed REQ poll
   bool in_config = true;
 };
 
@@ -132,32 +149,31 @@ class TokenScheduler {
         ++it;
       }
     }
-    cv_.notify_all();
   }
 
-  // Blocks until this pod is granted a token; returns quota in ms.
-  double Acquire(const std::string& pod, double est_ms) {
-    std::unique_lock<std::mutex> lock(mu_);
-    waiters_++;
-    // wait_for (not wait): eligibility can be restored purely by time
-    // passing (usage decay), which nothing notifies about
-    while (true) {
-      DecayAllLocked();
-      if (opt_.exclusive) {
-        if (holders_.empty() && Eligible(pod) && IsChosen(pod)) break;
-      } else {
-        if (Eligible(pod) && (Starved(pod) || !StarvedWaiterExists(pod))) break;
-      }
-      cv_.wait_for(lock, std::chrono::milliseconds(20));
-    }
-    waiters_--;
+  // One non-blocking grant attempt.  Returns {granted, quota_ms} on
+  // success, {false, retry_hint_ms} when the pod must poll again.
+  std::pair<bool, double> TryAcquire(const std::string& pod, double est_ms) {
+    std::lock_guard<std::mutex> lock(mu_);
+    DecayAllLocked();
+    double now = NowMs();
     PodQuota& q = Ensure(pod);
+    bool ok;
+    if (opt_.exclusive) {
+      ok = holders_.empty() && Eligible(pod) && IsChosen(pod, now);
+    } else {
+      ok = Eligible(pod) && (Starved(pod) || !StarvedWaiterExists(pod, now));
+    }
+    if (!ok) {
+      q.last_wait_poll = now;  // stays a live waiter for ~kWaiterStaleMs
+      return {false, RetryHintLocked(q)};
+    }
+    q.last_wait_poll = 0.0;
     q.grants++;
-    double quota = QuotaFor(q, est_ms);
+    double quota = QuotaFor(q, est_ms, now);
     holders_[pod]++;
-    q.grant_time = NowMs();
-    q.outstanding_quota = quota;
-    return quota;
+    q.outstanding_quotas.push_back({quota, now});
+    return {true, quota};
   }
 
   void Release(const std::string& pod, double used_ms) {
@@ -166,24 +182,46 @@ class TokenScheduler {
     if (it == holders_.end()) return;
     PodQuota& q = Ensure(pod);
     DecayLocked(q);
+    double quota = 0.0;
+    double granted_at = NowMs();
+    if (!q.outstanding_quotas.empty()) {
+      quota = q.outstanding_quotas.front().quota;  // FIFO: oldest retires
+      granted_at = q.outstanding_quotas.front().time;
+      q.outstanding_quotas.pop_front();
+    }
     // trust the measured device time but charge at least a fraction of the
     // grant — a client that always reports 0 would otherwise stay
     // perpetually under its request and monopolize the chip
-    double hold_ms = NowMs() - q.grant_time;
-    double floor_ms = std::min(0.05 * q.outstanding_quota, hold_ms);
-    q.used_ms += std::max(used_ms, floor_ms);
+    double hold_ms = NowMs() - granted_at;
+    double floor_ms = std::min(0.05 * quota, hold_ms);
+    double charge = std::max(used_ms, floor_ms);
+    q.used_ms += charge;
+    q.charged_total_ms += charge;
     if (--it->second <= 0) holders_.erase(it);
-    cv_.notify_all();
   }
 
-  // Connection died while holding a token: charge the full quota.
-  void Abandon(const std::string& pod) {
+  // Connection died while holding tokens: charge the full quota for each
+  // still-held grant, each priced at its own granted quota.  `count` is
+  // the connection's ledger of unreleased grants; the charge is bounded by
+  // how many the pod actually still holds.
+  void Abandon(const std::string& pod, int count = 1) {
     std::lock_guard<std::mutex> lock(mu_);
     auto it = holders_.find(pod);
     if (it == holders_.end()) return;
-    Ensure(pod).used_ms += Ensure(pod).outstanding_quota;
-    if (--it->second <= 0) holders_.erase(it);
-    cv_.notify_all();
+    int n = std::min(count, it->second);
+    if (n <= 0) return;
+    PodQuota& q = Ensure(pod);
+    for (int i = 0; i < n; i++) {
+      double quota = opt_.base_quota;
+      if (!q.outstanding_quotas.empty()) {
+        quota = q.outstanding_quotas.front().quota;
+        q.outstanding_quotas.pop_front();
+      }
+      q.used_ms += quota;
+      q.charged_total_ms += quota;
+    }
+    it->second -= n;
+    if (it->second <= 0) holders_.erase(it);
   }
 
   // MEM accounting: returns {ok, used, cap}.
@@ -203,9 +241,16 @@ class TokenScheduler {
   std::string Stat() {
     std::lock_guard<std::mutex> lock(mu_);
     DecayAllLocked();
+    double now = NowMs();
+    int holder_count = 0;
+    for (auto& kv : holders_) holder_count += kv.second;
+    int waiters = 0;
+    for (auto& kv : pods_) {
+      if (IsFreshWaiter(kv.second, now)) waiters++;
+    }
     std::ostringstream out;
     out << "{\"mode\":\"" << (opt_.exclusive ? "exclusive" : "concurrent")
-        << "\",\"holders\":" << holders_.size() << ",\"waiters\":" << waiters_
+        << "\",\"holders\":" << holder_count << ",\"waiters\":" << waiters
         << ",\"pods\":{";
     bool first = true;
     for (auto& kv : pods_) {
@@ -217,28 +262,32 @@ class TokenScheduler {
           << ",\"limit\":" << kv.second.limit
           << ",\"mem_used\":" << kv.second.mem_used
           << ",\"mem_cap\":" << kv.second.mem_cap
+          << ",\"charged_total_ms\":" << kv.second.charged_total_ms
           << ",\"grants\":" << kv.second.grants << "}";
     }
     out << "}}";
     return out.str();
   }
 
-  void NotifyAll() {
-    std::lock_guard<std::mutex> lock(mu_);
-    cv_.notify_all();
-  }
-
-  void RegisterWaiter(const std::string& pod, bool waiting) {
-    std::lock_guard<std::mutex> lock(mu_);
-    if (waiting) {
-      wait_set_[pod]++;
-    } else {
-      if (--wait_set_[pod] <= 0) wait_set_.erase(pod);
-    }
-    cv_.notify_all();
-  }
-
  private:
+  // A pod polled-and-WAITed within this horizon counts as an active waiter
+  // (client poll interval is ~5-20 ms; a crashed poller ages out fast).
+  static constexpr double kWaiterStaleMs = 1000.0;
+
+  static bool IsFreshWaiter(const PodQuota& q, double now) {
+    return q.last_wait_poll > 0.0 && now - q.last_wait_poll < kWaiterStaleMs;
+  }
+
+  // Suggested client poll delay: time until decay restores eligibility,
+  // clamped to a responsive band.
+  double RetryHintLocked(const PodQuota& q) {
+    double share = q.used_ms / opt_.window;
+    double hint = 5.0;
+    if (share >= q.limit && share > 0.0) {
+      hint = opt_.window * std::log(share / q.limit);
+    }
+    return std::min(100.0, std::max(5.0, hint));
+  }
   PodQuota& Ensure(const std::string& pod) {
     auto it = pods_.find(pod);
     if (it == pods_.end()) {
@@ -278,19 +327,24 @@ class TokenScheduler {
   }
 
   // another waiting pod is below its guaranteed share
-  bool StarvedWaiterExists(const std::string& self) {
-    for (auto& kv : wait_set_) {
-      if (kv.first != self && kv.second > 0 && Starved(kv.first)) return true;
+  bool StarvedWaiterExists(const std::string& self, double now) {
+    for (auto& kv : pods_) {
+      if (kv.first != self && IsFreshWaiter(kv.second, now) &&
+          Starved(kv.first)) {
+        return true;
+      }
     }
     return false;
   }
 
-  // Is `pod` the best eligible waiter right now?
-  bool IsChosen(const std::string& pod) {
+  // Is `pod` the best candidate among the active waiters right now?
+  bool IsChosen(const std::string& pod, double now) {
     std::string best;
     double best_key = 1e300;
-    for (auto& kv : wait_set_) {
-      PodQuota& q = Ensure(kv.first);
+    for (auto& kv : pods_) {
+      // candidates: active waiters plus the polling pod itself
+      if (kv.first != pod && !IsFreshWaiter(kv.second, now)) continue;
+      PodQuota& q = kv.second;
       double share = q.used_ms / opt_.window;
       if (share >= q.limit) continue;  // over limit
       double key;
@@ -309,8 +363,11 @@ class TokenScheduler {
     return best == pod;
   }
 
-  double QuotaFor(const PodQuota& q, double est_ms) {
-    size_t active = std::max<size_t>(1, wait_set_.size());
+  double QuotaFor(const PodQuota& q, double est_ms, double now) {
+    size_t active = 1;  // the grantee
+    for (auto& kv : pods_) {
+      if (&kv.second != &q && IsFreshWaiter(kv.second, now)) active++;
+    }
     double quota = opt_.base_quota / static_cast<double>(active);
     // cap at the pod's remaining window allowance
     double allowance = q.limit * opt_.window - q.used_ms;
@@ -321,11 +378,8 @@ class TokenScheduler {
 
   const Options& opt_;
   std::mutex mu_;
-  std::condition_variable cv_;
   std::map<std::string, PodQuota> pods_;
-  std::map<std::string, int> wait_set_;
   std::map<std::string, int> holders_;  // pod -> outstanding token count
-  int waiters_ = 0;
 };
 
 // ---------------------------------------------------------------------------
@@ -354,7 +408,11 @@ bool WriteAll(int fd, const std::string& data) {
 void ServeClient(int fd, TokenScheduler* sched) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  std::string holder_pod;  // pod name if this connection holds the token
+  // every token this connection holds (a client may pipeline several REQs
+  // before the matching RETs, or speak for more than one pod name); on
+  // disconnect each outstanding grant is abandoned so no stale holders_
+  // entry can wedge exclusive mode
+  std::map<std::string, int> outstanding;
   std::string line;
   while (ReadLine(fd, &line)) {
     std::istringstream in(line);
@@ -364,16 +422,19 @@ void ServeClient(int fd, TokenScheduler* sched) {
       double est = 0;
       in >> pod >> est;
       if (pod.empty()) break;
-      sched->RegisterWaiter(pod, true);
-      double quota = sched->Acquire(pod, est);
-      sched->RegisterWaiter(pod, false);
-      holder_pod = pod;
-      if (!WriteAll(fd, "TOK " + std::to_string(quota) + "\n")) break;
+      auto [granted, value] = sched->TryAcquire(pod, est);
+      if (granted) {
+        outstanding[pod]++;
+        if (!WriteAll(fd, "TOK " + std::to_string(value) + "\n")) break;
+      } else {
+        if (!WriteAll(fd, "WAIT " + std::to_string(value) + "\n")) break;
+      }
     } else if (cmd == "RET") {
       double used = 0;
       in >> pod >> used;
       sched->Release(pod, used);
-      holder_pod.clear();
+      auto it = outstanding.find(pod);
+      if (it != outstanding.end() && --it->second <= 0) outstanding.erase(it);
       if (!WriteAll(fd, "OK\n")) break;
     } else if (cmd == "MEM") {
       long long delta = 0;
@@ -388,7 +449,7 @@ void ServeClient(int fd, TokenScheduler* sched) {
       WriteAll(fd, "ERR unknown command\n");
     }
   }
-  if (!holder_pod.empty()) sched->Abandon(holder_pod);
+  for (auto& [pod, count] : outstanding) sched->Abandon(pod, count);
   close(fd);
 }
 
