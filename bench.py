@@ -55,11 +55,19 @@ def ensure_tokend() -> str:
 # ---------------------------------------------------------------------------
 
 def worker_main(args: argparse.Namespace) -> None:
+    # Phase stamps let the orchestrator see exactly where a hung accelerator
+    # runtime stalled (round-1 failure mode: 300s of silence; VERDICT #1).
+    print("PHASE importing", flush=True)
     if args.smoke:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
     import jax
+
+    print("PHASE imported", flush=True)
+    devices = jax.devices()  # first touch of the runtime: tunnel/client init
+    print(f"PHASE device-ready {devices[0].platform}", flush=True)
+
     import jax.numpy as jnp
 
     from kubeshare_tpu.isolation import ExecutionGuard, TokenClient
@@ -111,6 +119,7 @@ def worker_main(args: argparse.Namespace) -> None:
     # warmup/compile outside the measured window
     state, loss = train_step(state, 0, 0)
     jax.block_until_ready(loss)
+    print("PHASE compiled", flush=True)
 
     print("READY", flush=True)
     while not os.path.exists(args.barrier):
@@ -135,23 +144,119 @@ def worker_main(args: argparse.Namespace) -> None:
 # orchestrator
 # ---------------------------------------------------------------------------
 
+# Per-phase readiness budgets (seconds).  A worker that goes silent is
+# killed at its *current* phase's deadline — no more single opaque 300 s
+# watchdog (round-1 failure mode; VERDICT #1) — and the phase is retried
+# once with fresh processes before the bench gives up.
+PHASE_BUDGETS = {
+    "imported": 90.0,      # process start -> jax importable
+    "device-ready": 150.0, # jax.devices(): tunnel / TPU client init
+    "compiled": 240.0,     # first XLA compile (slowest cold step)
+    "READY": 30.0,
+}
+PHASE_ORDER = ["imported", "device-ready", "compiled", "READY"]
+
+
+class WorkerFailure(RuntimeError):
+    def __init__(self, message, diagnostics):
+        super().__init__(message)
+        self.diagnostics = diagnostics
+
+
+class _LineReader:
+    """Background line reader so the orchestrator can poll with deadlines."""
+
+    def __init__(self, proc):
+        import threading
+
+        self.proc = proc
+        self.lines: list = []
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        for line in self.proc.stdout:
+            with self._lock:
+                self.lines.append(line.strip())
+
+    def snapshot(self):
+        with self._lock:
+            return list(self.lines)
+
+
 class Phase:
     """One measurement phase: a fresh tokend + N worker processes released
     through a ready barrier.  A fresh tokend per phase keeps residual
     usage-window state from one phase from biasing the next."""
 
     def __init__(self, pods, tokend_binary, seconds, batch, smoke, io_wait_ms,
-                 ready_timeout=300.0, exclusive=False):
+                 exclusive=False, attempts=2):
         self.pods = pods
         self.tokend_binary = tokend_binary
         self.seconds = seconds
         self.batch = batch
         self.smoke = smoke
         self.io_wait_ms = io_wait_ms
-        self.ready_timeout = ready_timeout
         self.exclusive = exclusive
+        self.attempts = attempts
 
     def run(self):
+        last_failure = None
+        for attempt in range(self.attempts):
+            try:
+                return self._run_once()
+            except WorkerFailure as failure:
+                last_failure = failure
+                print(f"bench: attempt {attempt + 1} failed: {failure} "
+                      f"(diagnostics: {failure.diagnostics})", file=sys.stderr)
+        raise last_failure
+
+    def _await_ready(self, readers, spawn_time):
+        """Walk each worker through the phase sequence, each phase on its
+        own budget.  Returns per-worker phase timings; raises WorkerFailure
+        naming the stuck phase otherwise."""
+        timings = [dict() for _ in readers]
+        phase_start = spawn_time
+        for phase in PHASE_ORDER:
+            deadline = phase_start + PHASE_BUDGETS[phase]
+            pending = set(range(len(readers)))
+            while pending:
+                now = time.monotonic()
+                for i in list(pending):
+                    lines = readers[i].snapshot()
+                    if phase == "READY":
+                        reached = [ln for ln in lines if ln == "READY"]
+                    else:
+                        reached = [ln for ln in lines
+                                   if ln.startswith(f"PHASE {phase}")]
+                    if reached:
+                        timings[i][phase] = round(now - spawn_time, 1)
+                        pending.discard(i)
+                        continue
+                    if readers[i].proc.poll() is not None:
+                        raise WorkerFailure(
+                            f"worker {i} exited rc={readers[i].proc.returncode} "
+                            f"before phase {phase!r}",
+                            {"phase": phase, "lines": lines,
+                             "timings": timings},
+                        )
+                if not pending:
+                    break
+                if now >= deadline:
+                    stuck = sorted(pending)
+                    raise WorkerFailure(
+                        f"worker(s) {stuck} hung in phase {phase!r} "
+                        f"(budget {PHASE_BUDGETS[phase]:.0f}s)",
+                        {"phase": phase,
+                         "lines": [readers[i].snapshot() for i in stuck],
+                         "timings": timings},
+                    )
+                time.sleep(0.05)
+            phase_start = time.monotonic()
+        return timings
+
+    def _run_once(self):
         workdir = tempfile.mkdtemp(prefix="tpushare-bench-")
         uuid = "bench-chip-0"
         with open(os.path.join(workdir, uuid), "w") as f:
@@ -172,6 +277,7 @@ class Phase:
                     break
                 except OSError:
                     time.sleep(0.05)
+            spawn_time = time.monotonic()
             for pod in self.pods:
                 cmd = [
                     sys.executable, os.path.abspath(__file__), "--worker",
@@ -185,37 +291,43 @@ class Phase:
                     cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
                     text=True, cwd=REPO,
                 ))
-            import threading
-
-            def read_ready(proc, out):
-                out.append(proc.stdout.readline().strip())
-
-            # watchdog: start all readers first, join against one shared
-            # deadline — a hung accelerator runtime fails loudly at
-            # ready_timeout, not N x ready_timeout
-            readers = []
-            for proc in procs:
-                out: list = []
-                reader = threading.Thread(target=read_ready, args=(proc, out),
-                                          daemon=True)
-                reader.start()
-                readers.append((reader, out))
-            deadline = time.monotonic() + self.ready_timeout
-            for reader, out in readers:
-                reader.join(timeout=max(0.0, deadline - time.monotonic()))
-                if not out or out[0] != "READY":
-                    state = out[0] if out else "no output (runtime hung?)"
-                    raise RuntimeError(
-                        f"worker not ready within {self.ready_timeout:.0f}s: "
-                        f"{state!r}"
-                    )
+            readers = [_LineReader(proc) for proc in procs]
+            self.phase_timings = self._await_ready(readers, spawn_time)
+            self.platform = next(
+                (ln.split()[2] for ln in readers[0].snapshot()
+                 if ln.startswith("PHASE device-ready") and len(ln.split()) > 2),
+                "unknown",
+            )
             open(barrier, "w").close()
             results = []
-            for proc in procs:
-                out = proc.stdout.readline().strip()
-                proc.wait(timeout=600)
-                results.append(json.loads(out))
+            run_deadline = time.monotonic() + self.seconds + 120
+            for proc, reader in zip(procs, readers):
+                proc.wait(timeout=max(1.0, run_deadline - time.monotonic()))
+                # the reader thread may not have appended the final line yet;
+                # it exits as soon as the (now-closed) pipe drains
+                reader._thread.join(timeout=10)
+                payload = [ln for ln in reader.snapshot()
+                           if ln.startswith("{")]
+                if not payload:
+                    raise WorkerFailure(
+                        "worker produced no result JSON",
+                        {"phase": "measure", "lines": reader.snapshot()},
+                    )
+                try:
+                    results.append(json.loads(payload[-1]))
+                except ValueError:
+                    # truncated final line (worker killed mid-print): this
+                    # must stay retryable like every other worker failure
+                    raise WorkerFailure(
+                        "worker result JSON unparseable",
+                        {"phase": "measure", "lines": reader.snapshot()},
+                    )
             return results
+        except subprocess.TimeoutExpired as e:
+            raise WorkerFailure(
+                f"worker did not finish the measure window: {e}",
+                {"phase": "measure"},
+            )
         finally:
             for proc in procs:
                 if proc.poll() is None:
@@ -256,18 +368,19 @@ def main() -> None:
     common = dict(tokend_binary=tokend_binary, seconds=args.seconds,
                   batch=args.batch, smoke=args.smoke,
                   io_wait_ms=args.io_wait_ms, exclusive=args.exclusive)
-    solo_a_res = Phase(["bench/pod-a"], **common).run()[0]
+    phase_a = Phase(["bench/pod-a"], **common)
+    solo_a_res = phase_a.run()[0]
     solo_b_res = Phase(["bench/pod-b"], **common).run()[0]
     solo_a = solo_a_res["steps"] / args.seconds
     solo_b = solo_b_res["steps"] / args.seconds
-    corun = Phase(["bench/pod-a", "bench/pod-b"], **common).run()
+    corun_phase = Phase(["bench/pod-a", "bench/pod-b"], **common)
+    corun = corun_phase.run()
     agg = sum(r["steps"] for r in corun) / args.seconds
     solo_duty = (solo_a_res["gated_ms"] + solo_b_res["gated_ms"]) / (
         2 * args.seconds * 1e3
     )
 
     value = agg / (solo_a + solo_b) if (solo_a + solo_b) > 0 else 0.0
-    import jax  # platform tag only; orchestrator does no compute
 
     print(json.dumps({
         "metric": "2-pod x 0.5-chip MNIST co-run aggregate vs summed solo",
@@ -275,7 +388,10 @@ def main() -> None:
         "unit": "ratio",
         "vs_baseline": round(value / 0.90, 4),
         "detail": {
-            "platform": "cpu" if args.smoke else jax.devices()[0].platform,
+            # platform comes from the workers' device-ready stamps; the
+            # orchestrator itself never touches the accelerator runtime
+            # (a hung tunnel must not be able to wedge the report)
+            "platform": "cpu" if args.smoke else corun_phase.platform,
             "batch": args.batch,
             "window_s": args.seconds,
             "solo_a_steps_per_s": round(solo_a, 2),
@@ -284,6 +400,7 @@ def main() -> None:
             "corun_steps": [r["steps"] for r in corun],
             "corun_tokens": [r["tokens"] for r in corun],
             "solo_gated_duty": round(solo_duty, 3),
+            "phase_timings_s": corun_phase.phase_timings,
         },
     }))
 
@@ -295,11 +412,14 @@ if __name__ == "__main__":
         import traceback
 
         traceback.print_exc(file=sys.stderr)
-        print(json.dumps({
+        record = {
             "metric": "2-pod x 0.5-chip MNIST co-run aggregate vs summed solo",
             "value": 0.0,
             "unit": "ratio",
             "vs_baseline": 0.0,
             "error": f"{type(e).__name__}: {e}",
-        }))
+        }
+        if isinstance(e, WorkerFailure):
+            record["detail"] = e.diagnostics
+        print(json.dumps(record))
         sys.exit(1)

@@ -19,7 +19,14 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import jax
 
-if jax.default_backend() != "tpu":
+# Honor an explicit JAX_PLATFORMS request BEFORE backend init: the axon TPU
+# plugin ignores the env var, and probing the backend (default_backend())
+# would hang this CPU-friendly demo whenever the TPU tunnel is down
+# (same fix as __graft_entry__, commit a72a9ac).
+_requested = os.environ.get("JAX_PLATFORMS", "")
+if _requested:
+    jax.config.update("jax_platforms", _requested)
+elif jax.default_backend() != "tpu":
     jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp  # noqa: E402
 
