@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import attention_reference, flash_attention
-from ..ops.ring_attention import ring_attention
+from ..ops.ring_attention import ring_attention, ring_flash_attention
 from ..ops.rope import apply_rope, rope_positions
 
 
@@ -178,9 +178,15 @@ def transformer_apply_ring(
     mesh: Mesh,
     batch_axis: Optional[str] = "dp",
     seq_axis: str = "sp",
+    use_flash: Optional[bool] = None,
+    interpret: bool = False,
 ) -> jax.Array:
     """Sequence-parallel forward: tokens sharded over ``seq_axis``, ring
-    attention carrying K/V around the ICI ring (long-context path)."""
+    attention carrying K/V around the ICI ring (long-context path).
+
+    ``use_flash=None`` auto-selects the Pallas-fused ring body on TPU when
+    the per-device sequence shard reaches the kernel threshold (the kernel
+    win then compounds with sp — exactly where sequences are longest)."""
 
     if config.attention_window is not None:
         raise ValueError(
@@ -188,13 +194,22 @@ def transformer_apply_ring(
             "attention='flash' (windowed attention is local by nature and "
             "rarely needs sequence parallelism)"
         )
+    if use_flash is None:
+        from ..ops.ring_attention import ring_flash_auto
+
+        use_flash = ring_flash_auto(tokens.shape[1], mesh, seq_axis, interpret)
 
     def local_forward(params, tokens):
         local_seq = tokens.shape[1]
         offset = jax.lax.axis_index(seq_axis) * local_seq
-        attention_fn = lambda q, k, v: ring_attention(
-            q, k, v, axis_name=seq_axis, causal=True
-        )
+        if use_flash:
+            attention_fn = lambda q, k, v: ring_flash_attention(
+                q, k, v, axis_name=seq_axis, causal=True, interpret=interpret
+            )
+        else:
+            attention_fn = lambda q, k, v: ring_attention(
+                q, k, v, axis_name=seq_axis, causal=True
+            )
         return _forward(params, tokens, config, attention_fn, offset)
 
     return jax.shard_map(
@@ -202,6 +217,10 @@ def transformer_apply_ring(
         mesh=mesh,
         in_specs=(P(), P(batch_axis, seq_axis)),
         out_specs=P(batch_axis, seq_axis, None),
+        # only interpret-mode pallas evaluation trips the vma checker (its
+        # block slicing mixes varying/invariant operands); the compiled TPU
+        # kernel path keeps full checking over the whole forward
+        check_vma=not (use_flash and interpret),
     )(params, tokens)
 
 

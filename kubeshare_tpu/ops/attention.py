@@ -54,6 +54,13 @@ def attention_reference(
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
 
 
+def use_pallas_default(platform: str, seq_len: int, interpret: bool) -> bool:
+    """The one auto-select heuristic for every flash entry point: the
+    Pallas kernel on TPU for sequences >= 1024 (measured win threshold,
+    docs/perf.md), or when interpret mode forces it for CPU tests."""
+    return (platform == "tpu" and seq_len >= 1024) or interpret
+
+
 def _block_relevant(q_idx, k_idx, causal, block_q, block_k, window):
     """Static-shape test: can this (q block, k block) pair contain any
     unmasked entry?"""
@@ -190,11 +197,19 @@ def _flash_forward(
         _attention_kernel, causal=causal, block_q=block_q,
         block_k=block_k, n_kblocks=n_kblocks, window=window,
     )
+    # when called under a vma-checking shard_map, pallas out_shapes must
+    # state their varying mesh axes explicitly (the union of the inputs');
+    # outside shard_map this is the empty set and a no-op.  Interpret-mode
+    # callers still need check_vma=False at the shard_map site — the
+    # interpret evaluator's block slicing mixes varying and invariant
+    # operands — but the compiled TPU path lowers to one Mosaic call and
+    # checks fine with these annotations.
+    vma = jax.typeof(q).vma | jax.typeof(k).vma | jax.typeof(v).vma
     out, lse = pl.pallas_call(
         kernel,
         out_shape=(
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
+            jax.ShapeDtypeStruct(q.shape, q.dtype, vma=vma),
+            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32, vma=vma),
         ),
         grid=grid,
         in_specs=[
@@ -337,6 +352,8 @@ def _flash_backward(
     row_spec = pl.BlockSpec((1, 1, block_q, 1),
                            lambda bi, hi, xi, yi: (bi, hi, xi, 0))
 
+    vma = jax.typeof(q).vma | jax.typeof(k).vma | jax.typeof(v).vma
+
     # dk/dv: grid (b, h, kb, qb) — q sweeps innermost.  GQA: k/v are read
     # grouped (hi // group index map, no HBM repeat); dk/dv come out at full
     # query-head resolution and are group-reduced after the call.
@@ -346,8 +363,8 @@ def _flash_backward(
             block_k=block_k, n_qblocks=n_qblocks, window=window,
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((b, h, s, d), k.dtype),
-            jax.ShapeDtypeStruct((b, h, s, d), v.dtype),
+            jax.ShapeDtypeStruct((b, h, s, d), k.dtype, vma=vma),
+            jax.ShapeDtypeStruct((b, h, s, d), v.dtype, vma=vma),
         ),
         grid=(b, h, n_kblocks, n_qblocks),
         in_specs=[
@@ -386,7 +403,7 @@ def _flash_backward(
             _flash_bwd_dq_kernel, causal=causal, block_q=block_q,
             block_k=block_k, n_kblocks=n_kblocks, window=window,
         ),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype, vma=vma),
         grid=(b, h, n_qblocks, n_kblocks),
         in_specs=[
             qd_spec,  # q
@@ -460,8 +477,9 @@ def flash_attention(
     if window is not None and window <= 0:
         raise ValueError(f"window must be positive, got {window}")
     if use_pallas is None:
-        platform = jax.devices()[0].platform
-        use_pallas = (platform == "tpu" and q.shape[2] >= 1024) or interpret
+        use_pallas = use_pallas_default(
+            jax.devices()[0].platform, q.shape[2], interpret
+        )
     if not use_pallas:
         return attention_reference(q, k, v, causal, window)
     return _flash_attention(q, k, v, causal, block_q, interpret, window)

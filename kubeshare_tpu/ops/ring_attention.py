@@ -92,6 +92,150 @@ def ring_attention(
     return out.astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Pallas-fused ring: each ring step's block attention runs the flash kernel
+# (ops.attention) instead of a whole-shard einsum, so the kernel win
+# compounds with sequence parallelism exactly where sequences are longest.
+#
+# Per step the K/V shard's global position relative to the local Q shard is
+# one of three STATIC shapes — fully visible (source left of us on the
+# ring), diagonal (our own shard: standard causal), or fully masked
+# (source right of us) — selected with lax.switch, so each branch lowers a
+# kernel with a static mask and no per-element global-position math.
+# Partials merge by logsumexp weighting (the standard flash merge).
+# ---------------------------------------------------------------------------
+
+
+def _partial_flash(q, k, v, causal: bool, interpret: bool):
+    """One block's attention partial: (normalized out, lse [b,h,s]).
+
+    Uses the Pallas flash forward (which already computes lse as the
+    backward residual); falls back to a whole-shard XLA partial when the
+    local shape doesn't tile the kernel blocks."""
+    from .attention import _flash_forward
+
+    out, lse = _flash_forward(q, k, v, causal, block_q=512, interpret=interpret)
+    if lse is not None:
+        return out.astype(jnp.float32), lse[..., 0]
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.arange(s_q)[:, None] >= jnp.arange(s_k)[None, :]
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    block_lse = jax.nn.logsumexp(scores, axis=-1)  # -inf for masked rows
+    probs = jnp.where(
+        jnp.isfinite(scores),
+        jnp.exp(scores - jnp.where(jnp.isfinite(block_lse), block_lse, 0.0)[..., None]),
+        0.0,
+    )
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+    return out.astype(jnp.float32), block_lse
+
+
+def _merge_partials(out, lse, out_blk, lse_blk):
+    """Combine two normalized attention partials by their logsumexps."""
+    new_lse = jnp.logaddexp(lse, lse_blk)
+    safe = jnp.where(jnp.isfinite(new_lse), new_lse, 0.0)
+    w_old = jnp.where(jnp.isfinite(lse), jnp.exp(lse - safe), 0.0)
+    w_new = jnp.where(jnp.isfinite(lse_blk), jnp.exp(lse_blk - safe), 0.0)
+    merged = out * w_old[..., None] + out_blk * w_new[..., None]
+    return merged, new_lse
+
+
+def _ring_flash_forward(q, k, v, axis_name, causal, interpret):
+    axis_size = jax.lax.psum(1, axis_name)
+    my_index = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def block_partial(t, k_cur, v_cur):
+        if not causal:
+            return _partial_flash(q, k_cur, v_cur, False, interpret)
+        src = (my_index - t) % axis_size
+        # 0: src < my (fully visible), 1: src == my (diagonal causal),
+        # 2: src > my (fully masked)
+        branch = jnp.where(src == my_index, 1, jnp.where(src < my_index, 0, 2))
+
+        def full(k_b, v_b):
+            return _partial_flash(q, k_b, v_b, False, interpret)
+
+        def diag(k_b, v_b):
+            return _partial_flash(q, k_b, v_b, True, interpret)
+
+        def masked(k_b, v_b):
+            del k_b, v_b
+            zeros = jnp.zeros(q.shape, jnp.float32)
+            return zeros, jnp.full(q.shape[:-1], -jnp.inf, jnp.float32)
+
+        return jax.lax.switch(branch, (full, diag, masked), k_cur, v_cur)
+
+    def step(t, carry):
+        k_cur, v_cur, out, lse = carry
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        out_blk, lse_blk = block_partial(t, k_cur, v_cur)
+        out, lse = _merge_partials(out, lse, out_blk, lse_blk)
+        return k_next, v_next, out, lse
+
+    out0 = (q * 0).astype(jnp.float32)
+    lse0 = out0[..., 0] - jnp.inf
+    k_last, v_last, out, lse = jax.lax.fori_loop(
+        0, axis_size - 1, step, (k, v, out0, lse0)
+    )
+    out_blk, lse_blk = block_partial(axis_size - 1, k_last, v_last)
+    out, _ = _merge_partials(out, lse, out_blk, lse_blk)
+    return out.astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, axis_name, causal, interpret):
+    return _ring_flash_forward(q, k, v, axis_name, causal, interpret)
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, interpret):
+    return _ring_flash_forward(q, k, v, axis_name, causal, interpret), (q, k, v)
+
+
+def _ring_flash_bwd(axis_name, causal, interpret, residuals, g):
+    # backward recomputes the einsum ring and differentiates it — exact
+    # gradients (same math), flash-kernel speed kept on the forward; a
+    # fully kernelized ring backward (second ring pass over dk/dv/dq
+    # blocks) is the natural next step
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q, k, v: ring_attention(q, k, v, axis_name, causal), q, k, v
+    )
+    return vjp(g)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_flash_auto(
+    seq_len: int, mesh: Mesh, seq_axis: str, interpret: bool
+) -> bool:
+    """One source of truth for every ring entry point's flash auto-select:
+    the Pallas-fused body when the per-device shard reaches the kernel's
+    win threshold on this mesh's platform (or interpret forces it)."""
+    from .attention import use_pallas_default
+
+    s_local = seq_len // mesh.shape[seq_axis]
+    return use_pallas_default(mesh.devices.flat[0].platform, s_local, interpret)
+
+
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = True,
+    interpret: bool = False,
+) -> jax.Array:
+    """Ring attention with Pallas flash-kernel block math (call inside
+    shard_map, like :func:`ring_attention`)."""
+    return _ring_flash(q, k, v, axis_name, causal, interpret)
+
+
 def ring_attention_sharded(
     q: jax.Array,
     k: jax.Array,
@@ -101,11 +245,30 @@ def ring_attention_sharded(
     batch_axis: Optional[str] = "dp",
     seq_axis: str = "sp",
     head_axis: Optional[str] = "tp",
+    use_flash: Optional[bool] = None,
+    interpret: bool = False,
 ) -> jax.Array:
     """shard_map wrapper: [batch, heads, seq, head_dim] with batch over dp,
-    heads over tp, and sequence over sp."""
+    heads over tp, and sequence over sp.
+
+    ``use_flash=None`` auto-selects the Pallas-fused ring on TPU when the
+    per-device sequence shard is long enough for the kernel to win
+    (matching flash_attention's threshold); ``interpret=True`` forces the
+    kernel path in interpret mode for CPU tests."""
+    if use_flash is None:
+        use_flash = ring_flash_auto(q.shape[2], mesh, seq_axis, interpret)
     spec = P(batch_axis, head_axis, seq_axis, None)
-    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
+    if use_flash:
+        fn = functools.partial(
+            ring_flash_attention, axis_name=seq_axis, causal=causal,
+            interpret=interpret,
+        )
+    else:
+        fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
+    # interpret-mode pallas evaluation mixes varying and invariant operands
+    # in its block slicing, which the vma checker rejects; the compiled TPU
+    # kernel (and the einsum path) keep full checking
     return jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=not (use_flash and interpret),
     )(q, k, v)
