@@ -113,3 +113,170 @@ def pipeline_apply(
 def stack_stage_params(per_stage_params: list) -> Any:
     """Stack a list of per-stage param pytrees along a new leading axis."""
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B training schedule.
+#
+# pipeline_apply + jax.grad is GPipe: autodiff stashes every microbatch's
+# stage activations, O(num_microbatches) memory per stage.  The 1F1B
+# schedule interleaves each microbatch's backward as soon as its forward
+# clears the last stage, so a stage only keeps the activations of
+# microbatches still in flight — a window of at most 2*(stages-1)+1 slots,
+# independent of the microbatch count.
+#
+# Clock model: one loop over ticks, each tick a forward sub-phase and a
+# backward sub-phase (every stage does at most one F and one B per tick —
+# the 1F1B steady state).  Closed-form schedule indices:
+#     forward  of microbatch  m_f = t - s                    at stage s
+#     backward of microbatch  m_b = t - 2*(S-1) + s          at stage s
+# Dependencies hold: stage s forwards what stage s-1 forwarded last tick
+# (activations hop by ppermute), the last stage seeds each microbatch's
+# backward from its own same-tick forward, and grads hop back by reverse
+# ppermute.  Stage inputs are stashed in a static ring (in-flight window
+# max 2*(S-1-s)); the backward re-runs stage_fn under jax.vjp from the
+# stashed input (rematerialization — FLOPs for memory, the standard 1F1B
+# trade on TPU where HBM, not compute, binds pipeline depth).
+# ---------------------------------------------------------------------------
+
+
+def pipeline_train_1f1b(
+    stage_params: Any,
+    x: jax.Array,
+    y: jax.Array,
+    stage_fn: StageFn,
+    loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
+    mesh: Mesh,
+    num_microbatches: int,
+    pp_axis: str = "pp",
+):
+    """One pipelined training step under the 1F1B schedule.
+
+    Returns ``(loss, param_grads)`` where loss is the mean of
+    ``loss_fn(stage_output, y_microbatch)`` over microbatches and
+    ``param_grads`` matches ``stage_params`` (each stage's slice holding
+    that stage's gradients).  Gradient-equivalent to
+    ``jax.grad`` over :func:`pipeline_apply` (same math, different
+    schedule); activation memory is O(stages), not O(microbatches).
+    """
+    n_stages = mesh.shape[pp_axis]
+    if x.shape[0] % num_microbatches != 0:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible into {num_microbatches} microbatches"
+        )
+    for leaf in jax.tree.leaves(stage_params):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stage_params leading axis {leaf.shape[0]} != pipeline "
+                f"stages {n_stages} (mesh axis {pp_axis!r})"
+            )
+
+    param_specs = jax.tree.map(lambda _: P(pp_axis), stage_params)
+    slots = min(num_microbatches, 2 * n_stages - 1)
+
+    def staged(params, x, y):
+        stage = jax.lax.axis_index(pp_axis)
+        local_params = jax.tree.map(lambda p: p[0], params)
+        mb = x.shape[0] // num_microbatches
+        micro_x = x.reshape(num_microbatches, mb, *x.shape[1:])
+        micro_y = y.reshape(num_microbatches, mb, *y.shape[1:])
+
+        fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+        n_ticks = num_microbatches + 2 * (n_stages - 1)
+
+        varying_zero = (stage * 0).astype(micro_x.dtype)
+
+        def stage_out_shape():
+            probe = jax.eval_shape(
+                lambda p, xin: stage_fn(p, xin), local_params, micro_x[0]
+            )
+            return probe.shape, probe.dtype
+
+        out_shape, out_dtype = stage_out_shape()
+
+        fwd_carry0 = jnp.zeros(out_shape, out_dtype) + varying_zero.astype(out_dtype)
+        bwd_carry0 = jnp.zeros(out_shape, jnp.float32) + varying_zero.astype(jnp.float32)
+        stash0 = jnp.zeros((slots, *micro_x.shape[1:]), micro_x.dtype) + varying_zero
+        grads0 = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32)
+            + varying_zero.astype(jnp.float32),
+            local_params,
+        )
+        loss0 = jnp.zeros((), jnp.float32) + varying_zero.astype(jnp.float32)
+
+        def tick(t, carry):
+            fwd_carry, bwd_carry, stash, loss_sum, grads = carry
+
+            # ---- forward sub-phase: microbatch m_f = t - s ----
+            m_f = t - stage
+            f_valid = (m_f >= 0) & (m_f < num_microbatches)
+            safe_f = jnp.clip(m_f, 0, num_microbatches - 1)
+            x_in = jnp.where(stage == 0, micro_x[safe_f], fwd_carry.astype(micro_x.dtype))
+            y_out = stage_fn(local_params, x_in)
+            stash = jnp.where(
+                f_valid,
+                stash.at[safe_f % slots].set(x_in),
+                stash,
+            )
+
+            # last stage: loss value + backward seed for this microbatch
+            y_true = micro_y[safe_f]
+            loss_val, loss_vjp = jax.vjp(
+                lambda out: loss_fn(out, y_true), y_out.astype(jnp.float32)
+            )
+            # cotangent must carry the same varying-axes type as the primal
+            seed = (
+                jnp.float32(1.0 / num_microbatches)
+                + varying_zero.astype(jnp.float32)
+            )
+            (g_seed,) = loss_vjp(seed)
+            is_last = stage == n_stages - 1
+            loss_sum = loss_sum + jnp.where(
+                is_last & f_valid, loss_val / num_microbatches, 0.0
+            )
+
+            # ---- backward sub-phase: microbatch m_b = t - 2(S-1) + s ----
+            m_b = t - 2 * (n_stages - 1) + stage
+            b_valid = (m_b >= 0) & (m_b < num_microbatches)
+            safe_b = jnp.clip(m_b, 0, num_microbatches - 1)
+            # last stage seeds from its own same-tick forward (m_b == m_f
+            # there); inner stages use the grad hopped back last tick
+            g_in = jnp.where(is_last, g_seed, bwd_carry)
+            x_saved = stash[safe_b % slots]
+            _, stage_vjp = jax.vjp(
+                lambda p, xin: stage_fn(p, xin).astype(jnp.float32),
+                local_params, x_saved,
+            )
+            dparams, dx = stage_vjp(g_in)
+            grads = jax.tree.map(
+                lambda acc, d: acc + jnp.where(b_valid, d.astype(jnp.float32), 0.0),
+                grads, dparams,
+            )
+
+            # ---- hops ----
+            fwd_carry = jax.lax.ppermute(y_out, pp_axis, fwd_perm)
+            bwd_carry = jax.lax.ppermute(
+                jnp.where(b_valid, dx.astype(jnp.float32), jnp.zeros_like(dx, jnp.float32)),
+                pp_axis, bwd_perm,
+            )
+            return fwd_carry, bwd_carry, stash, loss_sum, grads
+
+        _, _, _, loss_sum, grads = jax.lax.fori_loop(
+            0, n_ticks, tick, (fwd_carry0, bwd_carry0, stash0, loss0, grads0)
+        )
+        # loss lives on the last stage; share it
+        loss = jax.lax.psum(loss_sum, pp_axis)
+        # grads: each stage keeps its own (restack leading axis of 1),
+        # cast back to the param dtype so updates don't silently promote
+        grads = jax.tree.map(
+            lambda g, p: g[None].astype(p.dtype), grads, local_params
+        )
+        return loss, grads
+
+    return jax.shard_map(
+        staged,
+        mesh=mesh,
+        in_specs=(param_specs, P(), P()),
+        out_specs=(P(), param_specs),  # grads shard exactly like params
+    )(stage_params, x, y)

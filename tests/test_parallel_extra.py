@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeshare_tpu.ops.moe import MoEConfig, moe_apply, moe_init, moe_sharding_rules
@@ -93,6 +94,135 @@ class TestPipeline:
         grads = jax.grad(loss)(stacked)
         assert np.isfinite(np.asarray(grads)).all()
         assert np.abs(np.asarray(grads)).sum() > 0
+
+
+class TestPipeline1F1B:
+    """1F1B schedule (VERDICT r1 #6): gradient equivalence vs GPipe-autodiff
+    and O(stages) activation stash instead of O(microbatches)."""
+
+    def _setup(self, n_stages=4, num_microbatches=8, d=8, batch=16):
+        from kubeshare_tpu.parallel.pipeline import pipeline_train_1f1b
+
+        mesh = Mesh(np.array(jax.devices()[:n_stages]).reshape(n_stages),
+                    ("pp",))
+
+        def stage_fn(params, x):
+            return jnp.tanh(x @ params["w"] + params["b"])
+
+        per_stage = [
+            {
+                "w": jax.random.normal(jax.random.PRNGKey(i), (d, d)) * 0.5,
+                "b": jnp.zeros((d,)) + 0.01 * i,
+            }
+            for i in range(n_stages)
+        ]
+        stacked = stack_stage_params(per_stage)
+        x = jax.random.normal(jax.random.PRNGKey(50), (batch, d))
+        y = jax.random.normal(jax.random.PRNGKey(51), (batch, d))
+
+        def loss_fn(out, target):
+            return ((out - target) ** 2).mean()
+
+        return pipeline_train_1f1b, mesh, stage_fn, stacked, x, y, loss_fn
+
+    def test_loss_and_grads_match_gpipe(self):
+        (train_1f1b, mesh, stage_fn, stacked, x, y,
+         loss_fn) = self._setup()
+        M = 8
+
+        loss_1f1b, grads_1f1b = train_1f1b(
+            stacked, x, y, stage_fn, loss_fn, mesh, num_microbatches=M
+        )
+
+        def gpipe_loss(params):
+            out = pipeline_apply(params, x, stage_fn, mesh, num_microbatches=M)
+            micro_out = out.reshape(M, -1, out.shape[-1])
+            micro_y = y.reshape(M, -1, y.shape[-1])
+            return jax.vmap(loss_fn)(micro_out, micro_y).mean()
+
+        loss_ref, grads_ref = jax.value_and_grad(gpipe_loss)(stacked)
+        np.testing.assert_allclose(float(loss_1f1b), float(loss_ref),
+                                   rtol=1e-5, atol=1e-6)
+        for key in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(grads_1f1b[key]), np.asarray(grads_ref[key]),
+                rtol=1e-4, atol=1e-5,
+            )
+
+    def test_two_stage_many_microbatches(self):
+        (train_1f1b, _, stage_fn, _, _, _, loss_fn) = self._setup()
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("pp",))
+        d, M = 4, 16  # microbatches >> stages: the stash must still be tiny
+        per_stage = [
+            {"w": jax.random.normal(jax.random.PRNGKey(i), (d, d)) * 0.5,
+             "b": jnp.zeros((d,))}
+            for i in range(2)
+        ]
+        stacked = stack_stage_params(per_stage)
+        x = jax.random.normal(jax.random.PRNGKey(3), (32, d))
+        y = jax.random.normal(jax.random.PRNGKey(4), (32, d))
+        from kubeshare_tpu.parallel.pipeline import pipeline_train_1f1b
+
+        loss, grads = pipeline_train_1f1b(
+            stacked, x, y, stage_fn, loss_fn, mesh, num_microbatches=M
+        )
+
+        def gpipe_loss(params):
+            out = pipeline_apply(params, x, stage_fn, mesh, num_microbatches=M)
+            micro_out = out.reshape(M, -1, d)
+            micro_y = y.reshape(M, -1, d)
+            return jax.vmap(loss_fn)(micro_out, micro_y).mean()
+
+        loss_ref, grads_ref = jax.value_and_grad(gpipe_loss)(stacked)
+        np.testing.assert_allclose(float(loss), float(loss_ref),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(grads["w"]),
+                                   np.asarray(grads_ref["w"]),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_activation_memory_is_o_stages(self):
+        """The compiled 1F1B program's activation stash is the static ring
+        of min(M, 2S-1) slots — grow M 4x and the live-buffer footprint
+        must stay ~flat (GPipe-autodiff grows linearly)."""
+        from kubeshare_tpu.parallel.pipeline import pipeline_train_1f1b
+
+        n_stages, d = 2, 8
+        mesh = Mesh(np.array(jax.devices()[:n_stages]).reshape(n_stages),
+                    ("pp",))
+
+        def stage_fn(params, x):
+            return jnp.tanh(x @ params["w"])
+
+        per_stage = [{"w": jnp.eye(d) * 0.5} for _ in range(n_stages)]
+        stacked = stack_stage_params(per_stage)
+
+        def loss_fn(out, target):
+            return ((out - target) ** 2).mean()
+
+        def peak_temp(M, batch):
+            x = jnp.zeros((batch, d))
+            y = jnp.zeros((batch, d))
+            compiled = (
+                jax.jit(
+                    lambda p: pipeline_train_1f1b(
+                        p, x, y, stage_fn, loss_fn, mesh, num_microbatches=M
+                    )
+                )
+                .lower(stacked)
+                .compile()
+            )
+            analysis = compiled.memory_analysis()
+            if analysis is None:
+                pytest.skip("backend exposes no memory analysis")
+            return analysis.temp_size_in_bytes
+
+        # microbatch size held constant (8): batch scales with M
+        small = peak_temp(M=4, batch=32)
+        large = peak_temp(M=16, batch=128)
+        # GPipe-autodiff would stash 4x the activations; the 1F1B ring is
+        # the same static size both times.  Allow 2x slack for XLA temps
+        # that legitimately scale with total batch (I/O staging etc.).
+        assert large <= 2 * max(small, 1), (small, large)
 
 
 class TestPipelinedTransformer:
