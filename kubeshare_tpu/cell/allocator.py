@@ -53,6 +53,19 @@ class CellAllocator:
         # (node, model) -> healthy leaves; membership only changes on
         # bind/health events, so Filter/Score walks hit this cache
         self._leaf_cache: Dict[Tuple[str, str], List[Cell]] = {}
+        # Feasibility cache (VERDICT r1 #7): Filter re-ran the full tree DFS
+        # for every (pod, node) pair, decaying throughput linearly with
+        # cluster size.  Fit results are memoized per
+        # (node, model, request, memory) and invalidated by generation
+        # counters: reserve/reclaim touch one node's availability only
+        # (shared ancestors' totals are never read by fit checks), so they
+        # bump that node's counter; health/inventory events can cascade
+        # through shared ancestors, so they bump the global counter.
+        self._fit_cache: Dict[
+            Tuple[str, str, float, int], Tuple[Tuple[int, int], Tuple[bool, float, int]]
+        ] = {}
+        self._fit_gen_global = 0
+        self._fit_node_gen: Dict[str, int] = {}
 
     # ------------------------------------------------------------------
     # inventory + health (ref node.go:109-285)
@@ -86,6 +99,7 @@ class CellAllocator:
         with self.lock:
             self.node_health[node] = healthy
             self._leaf_cache.clear()
+            self._fit_gen_global += 1
             for free_list in self.free_list.values():
                 for cell_list in free_list.values():
                     for cell in cell_list:
@@ -150,6 +164,7 @@ class CellAllocator:
                 current.free_memory -= memory
                 current.available = _quantize(current.available - request)
                 current.available_whole_cell = _floor(current.available)
+            self._invalidate_fit(cell.node)
 
     def reclaim(self, cell: Cell, request: float, memory: int) -> None:
         with self.lock:
@@ -157,6 +172,13 @@ class CellAllocator:
                 current.free_memory += memory
                 current.available = _quantize(current.available + request)
                 current.available_whole_cell = _floor(current.available)
+            self._invalidate_fit(cell.node)
+
+    def _invalidate_fit(self, node: str) -> None:
+        if node:
+            self._fit_node_gen[node] = self._fit_node_gen.get(node, 0) + 1
+        else:
+            self._fit_gen_global += 1
 
     # ------------------------------------------------------------------
     # fit checks (ref filter.go)
@@ -173,6 +195,12 @@ class CellAllocator:
         chip's free HBM negative at reserve time (latent reference bug:
         its Filter checked 0 while Reserve charged the default).
         """
+        key = (node, model, request, memory)
+        with self.lock:
+            gen = (self._fit_gen_global, self._fit_node_gen.get(node, 0))
+            hit = self._fit_cache.get(key)
+            if hit is not None and hit[0] == gen:
+                return hit[1]
         ok = False
         available = 0.0
         free_memory = 0
@@ -185,8 +213,15 @@ class CellAllocator:
                 available += cur_avail
                 free_memory += cur_mem
                 if ok:
-                    return ok, available, free_memory
-        return ok, available, free_memory
+                    break
+            if ok:
+                break
+        result = (ok, available, free_memory)
+        with self.lock:
+            if len(self._fit_cache) > 16384:  # many distinct request shapes
+                self._fit_cache.clear()
+            self._fit_cache[key] = (gen, result)
+        return result
 
     def check_cell_resource(
         self, cell: Cell, node: str, request: float, memory: int

@@ -10,6 +10,7 @@ the fields the framework actually reads/writes are modeled.
 from __future__ import annotations
 
 import enum
+import functools
 import itertools
 import threading
 from dataclasses import dataclass, field
@@ -44,8 +45,10 @@ class Pod:
     volumes: List[str] = field(default_factory=list)
     creation_timestamp: float = 0.0
 
-    @property
+    @functools.cached_property
     def key(self) -> str:
+        # namespace/name are fixed at construction (copy() builds a new Pod);
+        # the key is on every hot path, so compute it once per instance
         return f"{self.namespace}/{self.name}"
 
     def is_bound(self) -> bool:

@@ -153,6 +153,9 @@ class KubeShareScheduler:
     def _port_bitmap(self, node_name: str) -> RRBitmap:
         """Per-node pod-manager port pool; the creator masks index 0 so the
         first granted port is base+1 (ref node.go:37-39)."""
+        bitmap = self.port_bitmaps.get(node_name)  # lock-free hot path
+        if bitmap is not None:
+            return bitmap
         with self.port_lock:
             bitmap = self.port_bitmaps.get(node_name)
             if bitmap is None:
@@ -181,6 +184,11 @@ class KubeShareScheduler:
 
         needs_chip False + empty error -> regular pod.
         """
+        # lock-free fast path (hot: once per node per Filter/Score); dict
+        # reads are atomic under the GIL and a stale miss just falls through
+        cached = self.pod_status.get(pod.key)
+        if cached is not None and cached.uid == pod.uid:
+            return "", True, cached
         with self.pod_status_lock:
             cached = self.pod_status.get(pod.key)
             if cached is not None and cached.uid == pod.uid:
@@ -711,6 +719,8 @@ class KubeShareScheduler:
     def process_bound_pod_queue(self, node_name: str) -> None:
         """Scheduler-restart recovery: re-reserve resources for pods that
         were already bound before this process started (ref pod.go:528-582)."""
+        if node_name not in self.bound_pod_queue:  # lock-free hot path
+            return
         with self.bound_queue_lock:
             queue = self.bound_pod_queue.pop(node_name, [])
         for pod in queue:
