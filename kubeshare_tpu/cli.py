@@ -124,7 +124,12 @@ def cmd_launcher(args: argparse.Namespace) -> int:
         log.error("no chips found and none specified via --chip-uuids")
         return 1
     metric_servers = []
+    all_ports = [args.base_port + i for i in range(len(uuids))]
     for i, uuid in enumerate(uuids):
+        # every other chip of this node is a gang sibling: tokend -G keeps
+        # multi-chip fractional pods' grants aligned (docs/token-protocol.md)
+        siblings = tuple(p for p in all_ports if p != all_ports[i]) \
+            if args.gang_coordination else ()
         supervisor = ChipSupervisor(
             uuid,
             config_dir=args.config_dir,
@@ -134,6 +139,7 @@ def cmd_launcher(args: argparse.Namespace) -> int:
             min_quota_ms=args.min_quota,
             window_ms=args.window,
             log_dir=args.log_dir,
+            gang_peer_ports=siblings,
         )
         supervisor.start()
         supervisors.append(supervisor)
@@ -276,6 +282,10 @@ def main(argv=None) -> int:
                    default=constants.TOKEN_MIN_QUOTA_MS)
     p.add_argument("--window", type=float, default=constants.TOKEN_WINDOW_MS,
                    help="sliding accounting window ms (ref launcher.py:80)")
+    p.add_argument("--no-gang-coordination", dest="gang_coordination",
+                   action="store_false", default=True,
+                   help="run per-chip tokends independently (reference "
+                        "behavior) instead of gang-aligning grants via -G")
     p.set_defaults(fn=cmd_launcher)
 
     p = sub.add_parser("scheduler", help="scheduling control loop (ref pkg/scheduler)")

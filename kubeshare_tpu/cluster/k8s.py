@@ -128,17 +128,34 @@ class K8sCluster(ClusterAPI):
     def update_pod(self, pod: Pod) -> Pod:
         """Patch labels/annotations/env deltas; node assignment goes through
         bind_pod (env on existing containers is immutable in k8s — the
-        shadow bind mode exists for exactly that, ref scheduler.go:515-528)."""
+        shadow bind mode exists for exactly that, ref scheduler.go:515-528).
+
+        409 Conflict is retried with backoff: strategic-merge patches can
+        still conflict with a concurrent delete/recreate or an admission
+        webhook rewriting the object, and placement annotations must not
+        be dropped on the floor for a transient race."""
         patch = {
             "metadata": {
                 "labels": pod.labels,
                 "annotations": pod.annotations,
             }
         }
-        patched = self.core.patch_namespaced_pod(pod.name, pod.namespace, patch)
+        patched = self._patch_with_retry(pod.name, pod.namespace, patch)
         if pod.node_name and not (patched.spec.node_name or ""):
             self.bind_pod(pod.namespace, pod.name, pod.node_name)
         return pod
+
+    def _patch_with_retry(self, name: str, namespace: str, patch: dict,
+                          attempts: int = 4):
+        import time
+
+        for attempt in range(attempts):
+            try:
+                return self.core.patch_namespaced_pod(name, namespace, patch)
+            except self._client_mod.ApiException as e:
+                if e.status != 409 or attempt + 1 >= attempts:
+                    raise
+                time.sleep(0.05 * (2 ** attempt))
 
     def delete_pod(self, namespace: str, name: str) -> None:
         try:
@@ -204,7 +221,18 @@ class K8sCluster(ClusterAPI):
             self._start_watch("nodes")
 
     def _start_watch(self, kind: str) -> None:
+        """Informer-style watch loop: resume from the last seen
+        resourceVersion on reconnect (no full replay per blip); on 410 Gone
+        (history compacted) fall back to a fresh list, replayed as `update`
+        resync events plus synthesized `delete` events for objects that
+        vanished during the blind window (a plain relist would leak their
+        reservations forever).  Handlers must be idempotent — the engine's
+        add/update paths are (restart recovery re-reserves from
+        annotations, SURVEY §3.5)."""
+
         def run() -> None:
+            import time
+
             watch = self._watch_mod.Watch()
             list_fn = (
                 self.core.list_pod_for_all_namespaces
@@ -212,19 +240,68 @@ class K8sCluster(ClusterAPI):
             )
             convert = _to_pod if kind == "pods" else _to_node
             handlers = self._pod_handlers if kind == "pods" else self._node_handlers
+            key_of = ((lambda o: (o.namespace, o.name)) if kind == "pods"
+                      else (lambda o: o.name))
+            resource_version: Optional[str] = None
+            known: Dict = {}  # key -> last seen object, for resync deletes
+            need_resync = False
             while True:
+                # everything — including the resync list — stays inside the
+                # try: an API error during resync must retry, not silently
+                # kill the watch thread for the process lifetime
                 try:
-                    for event in watch.stream(list_fn, timeout_seconds=300):
+                    if need_resync:
+                        # raw list (not list_pods()): its resourceVersion
+                        # restarts the watch exactly where the list was
+                        # taken — resuming with no version would snapshot
+                        # at a later T1, silently dropping deletes in
+                        # (list, T1) and re-leaking what the resync fixed
+                        listed = list_fn()
+                        list_meta = getattr(listed, "metadata", None)
+                        resource_version = getattr(
+                            list_meta, "resource_version", None
+                        ) or None
+                        current = {}
+                        for raw in listed.items or []:
+                            obj = convert(raw)
+                            current[key_of(obj)] = obj
+                        for key, obj in list(known.items()):
+                            if key not in current:
+                                del known[key]
+                                for handler in list(handlers):
+                                    handler("delete", obj)
+                        for key, obj in current.items():
+                            known[key] = obj
+                            for handler in list(handlers):
+                                handler("update", obj)
+                        need_resync = False
+                    kwargs = {"timeout_seconds": 300}
+                    if resource_version:
+                        kwargs["resource_version"] = resource_version
+                    for event in watch.stream(list_fn, **kwargs):
                         event_type = {"ADDED": "add", "MODIFIED": "update",
                                       "DELETED": "delete"}.get(event["type"])
                         if event_type is None:
                             continue
-                        obj = convert(event["object"])
+                        raw = event["object"]
+                        rv = getattr(getattr(raw, "metadata", None),
+                                     "resource_version", None)
+                        if rv:
+                            resource_version = rv
+                        obj = convert(raw)
+                        if event_type == "delete":
+                            known.pop(key_of(obj), None)
+                        else:
+                            known[key_of(obj)] = obj
                         for handler in list(handlers):
                             handler(event_type, obj)
+                except self._client_mod.ApiException as e:
+                    if e.status == 410:  # Gone: our version was compacted
+                        resource_version = None
+                        need_resync = True
+                        continue
+                    time.sleep(2)
                 except Exception:
-                    import time
-
                     time.sleep(2)  # reconnect after watch errors
 
         thread = threading.Thread(target=run, daemon=True, name=f"watch-{kind}")

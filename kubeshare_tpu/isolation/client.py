@@ -74,6 +74,14 @@ class TokenClient:
     def release(self, used_ms: float) -> None:
         self._round_trip(f"RET {self.pod_name} {used_ms:.3f}\n")
 
+    def cancel(self) -> None:
+        """Roll back the newest grant with zero charge (gang unwind).
+
+        RET retires the pod's *oldest* grant FIFO-style — under overlapped
+        dispatch that would release a legitimately in-flight token; CAN
+        pops the just-granted one."""
+        self._round_trip(f"CAN {self.pod_name}\n")
+
     def request_memory(self, delta_bytes: int) -> Tuple[bool, int, int]:
         """Account an HBM delta; returns (granted, used, cap)."""
         reply = self._round_trip(f"MEM {self.pod_name} {delta_bytes}\n")
@@ -112,10 +120,106 @@ class TokenClient:
             self._sock = None
 
 
-class NativeTokenClient:
-    """ctypes binding over the C client (native/shim/client.cc)."""
+class GangTokenClient:
+    """One token client spanning the chips of a multi-chip (gang) pod.
 
-    def __init__(self, host: str, port: int, pod_name: str,
+    Wraps a ``TokenClient`` per chip broker behind the single-client
+    interface ``ExecutionGuard`` expects.  Chips are acquired in sorted
+    (host, port) order — a global lock order, so two gang pods sharing the
+    same chip set cannot hold-and-wait each other under the exclusive
+    tokend mode — and released together.  Server side, sibling tokends
+    launched with ``-G`` cross-check eligibility before granting, so by the
+    time the first chip grants, every chip of the gang is within one
+    quantum of granting: per-chip shares advance in lockstep and
+    synchronous collectives see uniform pacing (VERDICT r1 #9).
+
+    HBM deltas are charged to every chip's ledger: a gang pod's dominant
+    buffers (replicated parameters/optimizer state under data parallelism)
+    exist on each chip, so the replicated charge is the accurate model; a
+    deny on any chip rolls back the chips already charged.
+    """
+
+    def __init__(self, clients):
+        if not clients:
+            raise ValueError("gang client needs at least one endpoint")
+        self.clients = sorted(clients, key=lambda c: (c.host, c.port))
+        self.pod_name = self.clients[0].pod_name
+
+    def acquire(self, est_ms: float = 0.0) -> float:
+        quotas = []
+        for i, client in enumerate(self.clients):
+            try:
+                quotas.append(client.acquire(est_ms))
+            except Exception:
+                # a chip that failed mid-gang must not leave earlier chips
+                # held (under exclusive tokend mode a leaked hold blocks
+                # every co-tenant until this process dies); CAN pops the
+                # just-granted token — RET would retire the oldest one
+                for held in self.clients[:i]:
+                    try:
+                        held.cancel()
+                    except Exception:
+                        pass
+                raise
+        return min(quotas)  # budget bounded by the tightest chip
+
+    def release(self, used_ms: float) -> None:
+        first_error: Optional[Exception] = None
+        for client in self.clients:
+            try:
+                client.release(used_ms)
+            except Exception as e:  # keep returning the other chips' tokens
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            raise first_error
+
+    def request_memory(self, delta_bytes: int) -> Tuple[bool, int, int]:
+        charged = []
+        try:
+            for client in self.clients:
+                ok, used, cap = client.request_memory(delta_bytes)
+                if not ok:
+                    self._credit(charged, delta_bytes)
+                    return False, used, cap
+                charged.append(client)
+        except Exception:
+            # a broker that *errors* (vs a clean DENY) mid-gang must not
+            # leave earlier chips' ledgers charged: tokend's disconnect
+            # Abandon refunds tokens but never MEM, so a missed credit
+            # here would shrink the pod's headroom permanently
+            self._credit(charged, delta_bytes)
+            raise
+        return True, used, cap
+
+    @staticmethod
+    def _credit(charged, delta_bytes: int) -> None:
+        for done in charged:
+            try:
+                done.request_memory(-delta_bytes)
+            except Exception:
+                pass  # crediting is best-effort during unwind
+
+    def stat(self) -> str:
+        return "[" + ",".join(client.stat() for client in self.clients) + "]"
+
+    def ping(self) -> None:
+        for client in self.clients:
+            client.ping()
+
+    def close(self) -> None:
+        for client in self.clients:
+            client.close()
+
+
+class NativeTokenClient:
+    """ctypes binding over the C client (native/shim/client.cc).
+
+    ``port`` may be an int or a comma-separated string of gang broker
+    ports — the C client handles multi-endpoint acquire/release/MEM with
+    the same rollback semantics as :class:`GangTokenClient`."""
+
+    def __init__(self, host: str, port, pod_name: str,
                  library_path: Optional[str] = None):
         path = library_path or _find_client_library()
         if path is None:
@@ -123,8 +227,10 @@ class NativeTokenClient:
                 "libtpushare_client.so not found; run `make -C native`"
             )
         lib = ctypes.CDLL(path)
-        lib.tpushare_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p]
-        lib.tpushare_connect.restype = ctypes.c_int
+        lib.tpushare_connect_ports.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p
+        ]
+        lib.tpushare_connect_ports.restype = ctypes.c_int
         lib.tpushare_acquire.argtypes = [ctypes.c_double]
         lib.tpushare_acquire.restype = ctypes.c_double
         lib.tpushare_release.argtypes = [ctypes.c_double]
@@ -133,8 +239,10 @@ class NativeTokenClient:
         lib.tpushare_mem_request.restype = ctypes.c_int
         self._lib = lib
         self.pod_name = pod_name
-        if lib.tpushare_connect(host.encode(), port, pod_name.encode()) != 0:
-            raise ConnectionError(f"token endpoint {host}:{port} unreachable")
+        ports = str(port)
+        if lib.tpushare_connect_ports(
+                host.encode(), ports.encode(), pod_name.encode()) != 0:
+            raise ConnectionError(f"token endpoint {host}:{ports} unreachable")
 
     def acquire(self, est_ms: float = 0.0) -> float:
         quota = self._lib.tpushare_acquire(est_ms)
@@ -187,8 +295,22 @@ def connect_from_env(native: bool = False) -> Optional[TokenClient]:
             host = open(ip_file).read().strip()
         except OSError:
             host = "127.0.0.1"
+    host = host or "127.0.0.1"
+    if "," in port:
+        # multi-chip gang pod: one broker per chip, comma-separated ports
+        # (the scheduler injects them in chip order; sorted-order acquire
+        # is the gang lock order)
+        if native:
+            return NativeTokenClient(host, port, pod_name)
+        members = [
+            TokenClient(host, int(p), pod_name)
+            for p in port.split(",") if p.strip()
+        ]
+        gang = GangTokenClient(members)
+        gang.ping()
+        return gang
     if native:
-        return NativeTokenClient(host or "127.0.0.1", int(port), pod_name)
-    client = TokenClient(host or "127.0.0.1", int(port), pod_name)
+        return NativeTokenClient(host, int(port), pod_name)
+    client = TokenClient(host, int(port), pod_name)
     client.ping()  # surface an unreachable broker at setup, not mid-training
     return client

@@ -50,6 +50,7 @@ class ChipSupervisor:
         pmgr_binary: Optional[str] = None,
         poll_interval: float = 0.5,
         log_dir: Optional[str] = None,
+        gang_peer_ports: Optional[Tuple[int, ...]] = None,
     ) -> None:
         self.chip_uuid = chip_uuid
         self.config_dir = config_dir
@@ -58,6 +59,9 @@ class ChipSupervisor:
         self.base_quota_ms = base_quota_ms
         self.min_quota_ms = min_quota_ms
         self.window_ms = window_ms
+        # sibling tokend ports on this host (the node's other chips): wired
+        # into tokend -G so multi-chip gang pods' grants stay aligned
+        self.gang_peer_ports = tuple(gang_peer_ports or ())
         self.tokend_binary = tokend_binary or find_binary("tpushare-tokend")
         self.pmgr_binary = pmgr_binary or find_binary("tpushare-pmgr")
         self.poll_interval = poll_interval
@@ -120,18 +124,18 @@ class ChipSupervisor:
             self.reconcile()
 
     def _spawn_tokend(self) -> None:
-        self.tokend = subprocess.Popen(
-            [
-                self.tokend_binary,
-                "-p", self.config_dir,
-                "-f", self.chip_uuid,
-                "-P", str(self.tokend_port),
-                "-q", str(self.base_quota_ms),
-                "-m", str(self.min_quota_ms),
-                "-w", str(self.window_ms),
-            ],
-            start_new_session=True,
-        )
+        cmd = [
+            self.tokend_binary,
+            "-p", self.config_dir,
+            "-f", self.chip_uuid,
+            "-P", str(self.tokend_port),
+            "-q", str(self.base_quota_ms),
+            "-m", str(self.min_quota_ms),
+            "-w", str(self.window_ms),
+        ]
+        if self.gang_peer_ports:
+            cmd += ["-G", ",".join(str(p) for p in self.gang_peer_ports)]
+        self.tokend = subprocess.Popen(cmd, start_new_session=True)
 
     # ------------------------------------------------------------------
     def read_port_file(self) -> Dict[str, str]:

@@ -5,20 +5,29 @@
 // the PJRT interposer (libtpushim.so.1), from Python via ctypes (in-process
 // JAX gating, no LD_PRELOAD needed), and from tests.
 //
-// One connection, short round trips only.  REQ is non-blocking at the
-// broker ("TOK <quota>" or "WAIT <retry_ms>"); the wait loop lives HERE,
-// sleeping between polls with the connection mutex released.  That matters
-// because with completion-time charging tpushare_release() is called from
-// the runtime's event-callback thread: it interleaves freely between REQ
-// polls instead of queueing behind a server-side blocked REQ (which, in
-// the broker's exclusive mode, would deadlock — the REQ waits on the very
-// RET parked behind it).  One connection also keeps the broker's
-// per-connection grant ledger exact (every REQ's RET arrives on the same
-// connection, so a died client's outstanding grants — and only those — are
-// abandoned).
+// One connection per broker, short round trips only.  REQ is non-blocking
+// at the broker ("TOK <quota>" or "WAIT <retry_ms>"); the wait loop lives
+// HERE, sleeping between polls with the connection mutex released.  That
+// matters because with completion-time charging tpushare_release() is
+// called from the runtime's event-callback thread: it interleaves freely
+// between REQ polls instead of queueing behind a server-side blocked REQ
+// (which, in the broker's exclusive mode, would deadlock — the REQ waits
+// on the very RET parked behind it).  One connection per broker also keeps
+// the broker's per-connection grant ledger exact (every REQ's RET arrives
+// on the same connection, so a died client's outstanding grants — and only
+// those — are abandoned).
+//
+// Multi-chip (gang) pods: POD_MANAGER_PORT may be a comma-separated list,
+// one broker per chip (mirrors kubeshare_tpu.isolation.GangTokenClient).
+// Brokers are acquired in ascending port order — the gang lock order, so
+// two gang pods sharing a chip set cannot hold-and-wait each other under
+// the exclusive tokend mode — and released/charged together.  A failure
+// mid-gang rolls back the brokers already acquired/charged; silently
+// gating only the first chip would bypass isolation on the rest.
 //
 // Endpoint resolution (tpushare_init_from_env):
-//   POD_MANAGER_PORT          broker port (scheduler-injected)
+//   POD_MANAGER_PORT          broker port, or comma-separated gang ports
+//                             (scheduler-injected)
 //   POD_NAME                  "<ns>/<name>" (scheduler-injected)
 //   POD_MANAGER_IP            default 127.0.0.1 (node daemon is hostNetwork;
 //                             ref deploy/node-daemon.yaml:74)
@@ -31,22 +40,24 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <chrono>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
+#include <vector>
 
 namespace {
 
-struct Client {
+struct Endpoint {
   std::mutex mu;
   int fd = -1;
   std::string ip = "127.0.0.1";
   int port = 0;
-  std::string pod = "unknown/unknown";
 
   bool Connect() {
     if (fd >= 0) return true;
@@ -104,17 +115,84 @@ struct Client {
     }
     return false;
   }
+
+  ~Endpoint() { Drop(); }
 };
 
-Client* g_client() {
-  static Client c;
-  return &c;
+using EndpointPtr = std::shared_ptr<Endpoint>;
+
+// Gang membership.  Ops snapshot the vector under `mu` and then work on
+// their copy: a concurrent tpushare_connect* swaps in new endpoints while
+// in-flight round trips finish on the old ones (shared_ptr keeps them
+// alive), never a use-after-free.
+struct Gang {
+  std::mutex mu;
+  std::vector<EndpointPtr> eps;
+  std::string pod = "unknown/unknown";
+};
+
+Gang* g_gang() {
+  static Gang g;
+  return &g;
+}
+
+std::vector<EndpointPtr> Snapshot() {
+  Gang* g = g_gang();
+  std::lock_guard<std::mutex> lock(g->mu);
+  return g->eps;
 }
 
 std::string PodName() {
-  Client* c = g_client();
-  std::lock_guard<std::mutex> lock(c->mu);
-  return c->pod;
+  Gang* g = g_gang();
+  std::lock_guard<std::mutex> lock(g->mu);
+  return g->pod;
+}
+
+// Polls one broker until TOK; returns quota_ms, <0 on error.
+double AcquireOne(Endpoint& ep, const std::string& req) {
+  std::string reply;
+  while (true) {
+    if (!ep.RoundTrip(req, &reply)) return -1.0;
+    if (reply.rfind("TOK ", 0) == 0) return std::atof(reply.c_str() + 4);
+    if (reply.rfind("WAIT ", 0) == 0) {
+      double hint_ms = std::atof(reply.c_str() + 5);
+      if (hint_ms < 1.0) hint_ms = 1.0;
+      if (hint_ms > 100.0) hint_ms = 100.0;
+      std::this_thread::sleep_for(
+          std::chrono::microseconds(static_cast<long>(hint_ms * 1000)));
+      continue;
+    }
+    return -2.0;
+  }
+}
+
+int ConnectAll(const char* ip, const std::vector<int>& ports,
+               const char* pod_name) {
+  Gang* g = g_gang();
+  std::vector<EndpointPtr> eps;
+  for (int port : ports) {
+    auto ep = std::make_shared<Endpoint>();
+    if (ip != nullptr && *ip) ep->ip = ip;
+    ep->port = port;
+    eps.push_back(std::move(ep));
+  }
+  // ascending port order = the gang lock order (all brokers of one pod
+  // share the node daemon's IP, so the port alone orders them)
+  std::sort(eps.begin(), eps.end(),
+            [](const EndpointPtr& a, const EndpointPtr& b) {
+              return a->port < b->port;
+            });
+  bool ok = !eps.empty();
+  for (auto& ep : eps) {
+    std::lock_guard<std::mutex> lock(ep->mu);
+    if (!ep->Connect()) ok = false;
+  }
+  {
+    std::lock_guard<std::mutex> lock(g->mu);
+    g->eps = std::move(eps);
+    if (pod_name != nullptr && *pod_name) g->pod = pod_name;
+  }
+  return ok ? 0 : -1;
 }
 
 }  // namespace
@@ -122,13 +200,22 @@ std::string PodName() {
 extern "C" {
 
 int tpushare_connect(const char* ip, int port, const char* pod_name) {
-  Client* c = g_client();
-  std::lock_guard<std::mutex> lock(c->mu);
-  c->Drop();
-  if (ip != nullptr && *ip) c->ip = ip;
-  c->port = port;
-  if (pod_name != nullptr && *pod_name) c->pod = pod_name;
-  return c->Connect() ? 0 : -1;
+  return ConnectAll(ip, {port}, pod_name);
+}
+
+// Comma-separated broker ports — the multi-chip gang form.
+int tpushare_connect_ports(const char* ip, const char* ports_csv,
+                           const char* pod_name) {
+  std::vector<int> ports;
+  const char* p = ports_csv;
+  while (p != nullptr && *p) {
+    int port = std::atoi(p);
+    if (port > 0) ports.push_back(port);
+    const char* comma = std::strchr(p, ',');
+    p = (comma != nullptr) ? comma + 1 : nullptr;
+  }
+  if (ports.empty()) return -1;
+  return ConnectAll(ip, ports, pod_name);
 }
 
 // Reads the scheduler-injected env; returns 0 when a broker is configured.
@@ -155,64 +242,105 @@ int tpushare_init_from_env(void) {
     }
   }
   if (host.empty()) host = "127.0.0.1";
-  return tpushare_connect(host.c_str(), std::atoi(port),
-                          pod != nullptr ? pod : "");
+  return tpushare_connect_ports(host.c_str(), port, pod != nullptr ? pod : "");
 }
 
 int tpushare_connected(void) {
-  Client* c = g_client();
-  std::lock_guard<std::mutex> lock(c->mu);
-  return c->fd >= 0 ? 1 : 0;
+  auto eps = Snapshot();
+  if (eps.empty()) return 0;
+  for (const auto& ep : eps) {
+    std::lock_guard<std::mutex> lock(ep->mu);
+    if (ep->fd < 0) return 0;
+  }
+  return 1;
 }
 
-// Polls until a token is granted; returns quota_ms, or <0 on error.
-// The mutex is released while sleeping between WAIT polls.
+// Polls until a token is granted on EVERY broker of the gang (in port
+// order); returns the minimum quota_ms, or <0 on error.  A broker that
+// fails mid-gang rolls back the grants already taken — under exclusive
+// tokend mode a leaked hold would block every co-tenant of that chip.
 double tpushare_acquire(double est_ms) {
+  auto eps = Snapshot();
+  if (eps.empty()) return -1.0;
   std::string pod = PodName();
   char req[160];
   std::snprintf(req, sizeof(req), "REQ %s %.3f\n", pod.c_str(), est_ms);
-  std::string reply;
-  while (true) {
-    if (!g_client()->RoundTrip(req, &reply)) return -1.0;
-    if (reply.rfind("TOK ", 0) == 0) return std::atof(reply.c_str() + 4);
-    if (reply.rfind("WAIT ", 0) == 0) {
-      double hint_ms = std::atof(reply.c_str() + 5);
-      if (hint_ms < 1.0) hint_ms = 1.0;
-      if (hint_ms > 100.0) hint_ms = 100.0;
-      std::this_thread::sleep_for(
-          std::chrono::microseconds(static_cast<long>(hint_ms * 1000)));
-      continue;
+  // rollback cancels the NEWEST grant (CAN) rather than RETiring: RET
+  // retires the pod's oldest grant FIFO-style, which under overlapped
+  // dispatch would release a legitimately in-flight token
+  char can[160];
+  std::snprintf(can, sizeof(can), "CAN %s\n", pod.c_str());
+  double min_quota = 0.0;
+  for (size_t i = 0; i < eps.size(); i++) {
+    double quota = AcquireOne(*eps[i], req);
+    if (quota < 0) {
+      std::string reply;
+      for (size_t j = 0; j < i; j++) eps[j]->RoundTrip(can, &reply);
+      return quota;
     }
-    return -2.0;
+    min_quota = (i == 0) ? quota : std::min(min_quota, quota);
   }
+  return min_quota;  // budget bounded by the tightest chip
 }
 
-// Reports measured device time for the held token; 0 on success.
+// Reports measured device time for the held token(s); 0 on success.
+// Every broker is told even if one fails — the others' tokens must not
+// stay held because a sibling connection dropped.
 int tpushare_release(double used_ms) {
-  std::string reply;
+  auto eps = Snapshot();
+  if (eps.empty()) return -1;
   char req[160];
   std::snprintf(req, sizeof(req), "RET %s %.3f\n", PodName().c_str(), used_ms);
-  if (!g_client()->RoundTrip(req, &reply)) return -1;
-  return reply == "OK" ? 0 : -2;
+  int rc = 0;
+  for (auto& ep : eps) {
+    std::string reply;
+    if (!ep->RoundTrip(req, &reply)) {
+      if (rc == 0) rc = -1;
+    } else if (reply != "OK" && rc == 0) {
+      rc = -2;
+    }
+  }
+  return rc;
 }
 
-// Accounts a memory delta against the pod's HBM cap.
-// Returns 1 granted, 0 denied, <0 error.
+// Accounts a memory delta against the pod's HBM cap on every chip of the
+// gang (replicated parameters exist on each chip — the replicated charge
+// is the accurate model; see GangTokenClient).  A DENY or error on any
+// chip credits the chips already charged.  Returns 1 granted, 0 denied,
+// <0 error.
 int tpushare_mem_request(long long delta_bytes) {
-  std::string reply;
+  auto eps = Snapshot();
+  if (eps.empty()) return -1;
   char req[160];
   std::snprintf(req, sizeof(req), "MEM %s %lld\n", PodName().c_str(),
                 delta_bytes);
-  if (!g_client()->RoundTrip(req, &reply)) return -1;
-  if (reply.rfind("OK", 0) == 0) return 1;
-  if (reply.rfind("DENY", 0) == 0) return 0;
-  return -2;
+  char credit[160];
+  std::snprintf(credit, sizeof(credit), "MEM %s %lld\n", PodName().c_str(),
+                -delta_bytes);
+  std::string reply;
+  for (size_t i = 0; i < eps.size(); i++) {
+    int rc;
+    if (!eps[i]->RoundTrip(req, &reply)) {
+      rc = -1;
+    } else if (reply.rfind("OK", 0) == 0) {
+      continue;
+    } else if (reply.rfind("DENY", 0) == 0) {
+      rc = 0;
+    } else {
+      rc = -2;
+    }
+    std::string ignored;
+    for (size_t j = 0; j < i; j++) eps[j]->RoundTrip(credit, &ignored);
+    return rc;
+  }
+  return 1;
 }
 
 void tpushare_disconnect(void) {
-  Client* c = g_client();
-  std::lock_guard<std::mutex> lock(c->mu);
-  c->Drop();
+  for (const auto& ep : Snapshot()) {
+    std::lock_guard<std::mutex> lock(ep->mu);
+    ep->Drop();
+  }
 }
 
 }  // extern "C"

@@ -25,6 +25,7 @@
 //   RET <pod> <used_ms>  -> OK
 //   MEM <pod> <delta>    -> OK <used> <cap> | DENY <used> <cap>
 //   STAT                 -> one JSON line
+//   ELIG <pod>           -> ELIG <0|1> <retry_ms>   (gang probe, see -G)
 //
 // REQ is NON-blocking: an ineligible pod gets "WAIT <retry_ms>" and polls.
 // Rationale: with completion-time charging the client's RET is sent from
@@ -67,8 +68,10 @@
 #include <fstream>
 #include <iostream>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <sstream>
+#include <tuple>
 #include <string>
 #include <thread>
 #include <vector>
@@ -114,6 +117,13 @@ struct Options {
   double min_quota = 20.0;
   double window = 10000.0;
   bool exclusive = false;
+  // Sibling tokend ports on this host (-G p1,p2,...): the chips of one
+  // gang.  A REQ is granted only when every sibling that shares the pod
+  // would also grant it, so a multi-chip fractional pod's per-chip grants
+  // stay aligned within one quantum instead of running ahead on an idle
+  // chip while starved on a busy one (which skews synchronous
+  // collectives; the reference's per-GPU gem-schd had the same blindness).
+  std::vector<int> gang_peers;
 };
 
 class TokenScheduler {
@@ -222,6 +232,79 @@ class TokenScheduler {
     }
     it->second -= n;
     if (it->second <= 0) holders_.erase(it);
+  }
+
+  // Roll back the NEWEST outstanding grant with zero charge: the token
+  // was never used (a sibling broker of the gang failed mid-acquire and
+  // the client is unwinding).  RET would retire the pod's OLDEST grant
+  // (FIFO) — under overlapped dispatch that releases a legitimately
+  // in-flight token at the floor charge and later shifts its measured
+  // device time onto the wrong grant.
+  bool Cancel(const std::string& pod) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = holders_.find(pod);
+    if (it == holders_.end()) return false;
+    PodQuota& q = Ensure(pod);
+    if (!q.outstanding_quotas.empty()) q.outstanding_quotas.pop_back();
+    if (--it->second <= 0) holders_.erase(it);
+    return true;
+  }
+
+  // Gang probe from a sibling tokend: would this chip grant `pod` a token
+  // right now?  Purely local — never consults peers (no recursion) and
+  // never creates pod state.  Three answers shape the cross-chip
+  // behavior:
+  //   * pod unknown / not in this chip's config  -> eligible (not shared
+  //     here; this chip does not constrain the gang);
+  //   * pod already holds a token here           -> eligible (its grant on
+  //     this chip is satisfied; a sibling acquiring second must not be
+  //     blocked by the pod's own first grant);
+  //   * otherwise the same eligibility test REQ would apply.
+  struct ProbeResult {
+    bool eligible;
+    double retry_ms;
+    bool known;  // pod present in this chip's config (gang sibling here)
+  };
+
+  ProbeResult ProbeEligible(const std::string& pod) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = pods_.find(pod);
+    if (it == pods_.end() || !it->second.in_config) return {true, 0.0, false};
+    if (holders_.count(pod) > 0) return {true, 0.0, true};
+    DecayAllLocked();
+    double now = NowMs();
+    bool ok;
+    if (opt_.exclusive) {
+      ok = holders_.empty() && Eligible(pod) && IsChosen(pod, now);
+    } else {
+      ok = Eligible(pod) && (Starved(pod) || !StarvedWaiterExists(pod, now));
+    }
+    if (ok) return {true, 0.0, true};
+    return {false, RetryHintLocked(it->second), true};
+  }
+
+  // TryAcquire's eligibility half for the gang-gated REQ path: same
+  // answer TryAcquire would give, registers the pod as a live waiter on
+  // WAIT (so exclusive-mode arbitration keeps seeing it — ProbeEligible
+  // deliberately does neither), but commits no grant.  Lets the gated
+  // path answer the locally-throttled majority with a single scheduler
+  // scan and consult peers only when this chip would actually grant.
+  std::pair<bool, double> PreflightAcquire(const std::string& pod) {
+    std::lock_guard<std::mutex> lock(mu_);
+    DecayAllLocked();
+    double now = NowMs();
+    PodQuota& q = Ensure(pod);
+    bool ok;
+    if (opt_.exclusive) {
+      ok = holders_.empty() && Eligible(pod) && IsChosen(pod, now);
+    } else {
+      ok = Eligible(pod) && (Starved(pod) || !StarvedWaiterExists(pod, now));
+    }
+    if (!ok) {
+      q.last_wait_poll = now;  // stays a live waiter for ~kWaiterStaleMs
+      return {false, RetryHintLocked(q)};
+    }
+    return {true, 0.0};
   }
 
   // MEM accounting: returns {ok, used, cap}.
@@ -405,7 +488,144 @@ bool WriteAll(int fd, const std::string& data) {
   return true;
 }
 
-void ServeClient(int fd, TokenScheduler* sched) {
+// Persistent connections to the sibling tokends of a gang (-G).  Queries
+// are fail-open: a dead or slow sibling must never stall this chip (the
+// supervisor will restart it; until then the gang constraint is simply
+// not enforced, matching the reference's independent-daemon behavior).
+// A sibling that fails a probe is backed off for kBackoffMs (skipped,
+// fail-open) so a wedged-but-listening daemon costs the node at most one
+// read timeout per backoff period, not one per REQ.
+//
+// Siblings that answered "pod not in my config" are cached for
+// kUnknownTtlMs and skipped for that pod: a single-chip pod on an 8-chip
+// host would otherwise pay 7 serialized loopback probes per granted REQ
+// forever, despite having no gang to align.  The TTL re-checks at about
+// the configd rewrite cadence, so a pod that *becomes* multi-chip (or a
+// config reload that adds it to a sibling) is picked up within ~5s.
+class PeerGate {
+ public:
+  explicit PeerGate(const std::vector<int>& ports) {
+    for (int p : ports) peers_.emplace_back(new Peer(p));
+  }
+
+  // All-of semantics: {every reachable sibling would grant, max retry hint}.
+  std::pair<bool, double> AllEligible(const std::string& pod) {
+    bool ok = true;
+    double hint = 0.0;
+    for (auto& peer : peers_) {
+      bool elig = true;
+      double peer_hint = 0.0;
+      if (!Query(*peer, pod, &elig, &peer_hint)) continue;  // fail-open
+      if (!elig) {
+        ok = false;
+        hint = std::max(hint, peer_hint);
+      }
+    }
+    return {ok, hint};
+  }
+
+ private:
+  static constexpr double kBackoffMs = 1000.0;
+  static constexpr double kUnknownTtlMs = 5000.0;
+
+  struct Peer {
+    explicit Peer(int port_in) : port(port_in) {}
+    int port;
+    int fd = -1;
+    double backoff_until = 0.0;  // NowMs deadline; guarded by mu
+    // pod -> NowMs deadline: peer answered "not in my config"; skip
+    // probing it for this pod until the deadline passes
+    std::map<std::string, double> unknown_until;
+    std::mutex mu;
+  };
+
+  static int ConnectLocal(int port) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    // short timeouts: a wedged sibling degrades to fail-open, not a stall
+    struct timeval tv = {0, 200000};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+      close(fd);
+      return -1;
+    }
+    return fd;
+  }
+
+  bool Query(Peer& peer, const std::string& pod, bool* elig, double* hint) {
+    std::lock_guard<std::mutex> lock(peer.mu);
+    double now = NowMs();
+    if (now < peer.backoff_until) return false;  // recently unresponsive
+    auto unknown = peer.unknown_until.find(pod);
+    if (unknown != peer.unknown_until.end()) {
+      if (now < unknown->second) {
+        *elig = true;  // peer doesn't share this pod: no constraint
+        *hint = 0.0;
+        return true;
+      }
+      peer.unknown_until.erase(unknown);
+    }
+    // Retry once only when a *cached* connection proved stale at write
+    // time; a fresh connection that times out is not retried, so the
+    // worst case per probe is one read timeout (~200 ms), after which the
+    // peer is backed off.
+    for (int attempt = 0; attempt < 2; attempt++) {
+      bool fresh = false;
+      if (peer.fd < 0) {
+        peer.fd = ConnectLocal(peer.port);
+        fresh = true;
+      }
+      if (peer.fd < 0) break;
+      if (!WriteAll(peer.fd, "ELIG " + pod + "\n")) {
+        close(peer.fd);
+        peer.fd = -1;
+        if (fresh) break;
+        continue;
+      }
+      std::string line;
+      if (!ReadLine(peer.fd, &line)) {
+        close(peer.fd);
+        peer.fd = -1;
+        if (fresh) break;
+        continue;
+      }
+      std::istringstream in(line);
+      std::string tag;
+      int e = 1;
+      double h = 0.0;
+      in >> tag >> e >> h;
+      int known = 1;
+      // two-field reply (sibling predating the known field): count it as
+      // sharing — a bare `in >> known` would write 0 on failed extraction
+      // (C++11), silently caching the pod as unshared for the TTL
+      if (!(in >> known)) known = 1;
+      if (tag != "ELIG") {
+        close(peer.fd);
+        peer.fd = -1;
+        break;
+      }
+      if (known == 0) {
+        peer.unknown_until[pod] = NowMs() + kUnknownTtlMs;
+      }
+      *elig = e != 0;
+      *hint = h;
+      return true;
+    }
+    peer.backoff_until = NowMs() + kBackoffMs;
+    return false;
+  }
+
+  std::vector<std::unique_ptr<Peer>> peers_;
+};
+
+void ServeClient(int fd, TokenScheduler* sched, PeerGate* gate) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   // every token this connection holds (a client may pipeline several REQs
@@ -422,12 +642,44 @@ void ServeClient(int fd, TokenScheduler* sched) {
       double est = 0;
       in >> pod >> est;
       if (pod.empty()) break;
-      auto [granted, value] = sched->TryAcquire(pod, est);
-      if (granted) {
-        outstanding[pod]++;
-        if (!WriteAll(fd, "TOK " + std::to_string(value) + "\n")) break;
-      } else {
-        if (!WriteAll(fd, "WAIT " + std::to_string(value) + "\n")) break;
+      // gang gate (outside the scheduler lock): if a sibling chip would
+      // not grant this pod, WAIT here too so the gang's per-chip grants
+      // advance in lockstep.  Local eligibility is settled first via one
+      // PreflightAcquire scan — the locally-throttled steady-state
+      // majority answers WAIT with no peer traffic and no second scan —
+      // and peers are consulted only when this chip would grant.
+      bool gated_out = false;
+      if (gate != nullptr) {
+        auto [local_ok, local_hint] = sched->PreflightAcquire(pod);
+        if (!local_ok) {
+          gated_out = true;
+          if (!WriteAll(fd, "WAIT " + std::to_string(local_hint) + "\n"))
+            break;
+        } else {
+          auto [peers_ok, peer_hint] = gate->AllEligible(pod);
+          if (!peers_ok) {
+            gated_out = true;
+            double hint = std::max(5.0, std::min(100.0, peer_hint));
+            if (!WriteAll(fd, "WAIT " + std::to_string(hint) + "\n")) break;
+          }
+        }
+      }
+      if (!gated_out) {
+        auto [granted, value] = sched->TryAcquire(pod, est);
+        if (granted) {
+          outstanding[pod]++;
+          if (!WriteAll(fd, "TOK " + std::to_string(value) + "\n")) break;
+        } else {
+          if (!WriteAll(fd, "WAIT " + std::to_string(value) + "\n")) break;
+        }
+      }
+    } else if (cmd == "ELIG") {
+      in >> pod;
+      auto probe = sched->ProbeEligible(pod);
+      if (!WriteAll(fd, std::string("ELIG ") + (probe.eligible ? "1" : "0") +
+                            " " + std::to_string(probe.retry_ms) + " " +
+                            (probe.known ? "1" : "0") + "\n")) {
+        break;
       }
     } else if (cmd == "RET") {
       double used = 0;
@@ -435,6 +687,14 @@ void ServeClient(int fd, TokenScheduler* sched) {
       sched->Release(pod, used);
       auto it = outstanding.find(pod);
       if (it != outstanding.end() && --it->second <= 0) outstanding.erase(it);
+      if (!WriteAll(fd, "OK\n")) break;
+    } else if (cmd == "CAN") {
+      in >> pod;
+      if (sched->Cancel(pod)) {
+        auto it = outstanding.find(pod);
+        if (it != outstanding.end() && --it->second <= 0)
+          outstanding.erase(it);
+      }
       if (!WriteAll(fd, "OK\n")) break;
     } else if (cmd == "MEM") {
       long long delta = 0;
@@ -495,7 +755,9 @@ void WatchConfig(const Options& opt, TokenScheduler* sched,
 
 int main(int argc, char** argv) {
   Options opt;
-  for (int i = 1; i < argc - 1; i++) {
+  // i + 1 < argc: every flag below consumes a value, so a trailing bare
+  // flag is skipped rather than reading past argv (-x is scanned later)
+  for (int i = 1; i + 1 < argc; i++) {
     std::string flag = argv[i];
     if (flag == "-p") opt.config_dir = argv[++i];
     else if (flag == "-f") opt.config_file = argv[++i];
@@ -503,18 +765,29 @@ int main(int argc, char** argv) {
     else if (flag == "-q") opt.base_quota = std::atof(argv[++i]);
     else if (flag == "-m") opt.min_quota = std::atof(argv[++i]);
     else if (flag == "-w") opt.window = std::atof(argv[++i]);
+    else if (flag == "-G") {
+      std::istringstream list(argv[++i]);
+      std::string tok;
+      while (std::getline(list, tok, ',')) {
+        int p = std::atoi(tok.c_str());
+        if (p > 0) opt.gang_peers.push_back(p);
+      }
+    }
   }
   for (int i = 1; i < argc; i++) {
     if (std::string(argv[i]) == "-x") opt.exclusive = true;
   }
   if (opt.config_dir.empty() || opt.config_file.empty()) {
     std::cerr << "usage: tpushare-tokend -p <dir> -f <file> -P <port> "
-                 "[-q base_quota_ms] [-m min_quota_ms] [-w window_ms]\n";
+                 "[-q base_quota_ms] [-m min_quota_ms] [-w window_ms] "
+                 "[-x] [-G peer_port,peer_port,...]\n";
     return 2;
   }
 
   TokenScheduler sched(opt);
   sched.LoadConfig(opt.config_dir + "/" + opt.config_file);
+  std::unique_ptr<PeerGate> gate;
+  if (!opt.gang_peers.empty()) gate.reset(new PeerGate(opt.gang_peers));
 
   std::atomic<bool> running{true};
   std::thread watcher(WatchConfig, std::cref(opt), &sched, &running);
@@ -544,7 +817,7 @@ int main(int argc, char** argv) {
       if (errno == EINTR) continue;
       break;
     }
-    std::thread(ServeClient, fd, &sched).detach();
+    std::thread(ServeClient, fd, &sched, gate.get()).detach();
   }
   running.store(false);
   watcher.join();

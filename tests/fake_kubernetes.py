@@ -1,0 +1,254 @@
+"""Minimal in-memory fake of the ``kubernetes`` client surface that
+``kubeshare_tpu.cluster.k8s`` touches (VERDICT r1 #10: the real package is
+not in this image, so the adapter gets a mocked-API-server integration
+harness instead).
+
+Scope: exactly the classes/methods the adapter calls —
+``client.CoreV1Api`` (list/read/create/patch/delete pod, list node, bind
+subresource), ``client.ApiException``, the ``V1Binding`` object family,
+``config.load_*``, and ``watch.Watch.stream``.  Fault-injection knobs on
+``FakeStore`` drive the failure paths: patch 409s, watch stream errors,
+410 Gone compaction.
+
+Use ``install(monkeypatch)`` to register the fake under ``sys.modules``
+before the adapter's lazy ``import kubernetes`` runs.
+"""
+
+from __future__ import annotations
+
+import queue
+import types
+from typing import Optional
+
+
+class ApiException(Exception):
+    def __init__(self, status: int = 500, reason: str = ""):
+        super().__init__(f"({status}) {reason}")
+        self.status = status
+        self.reason = reason
+
+
+def _ns(**kwargs) -> types.SimpleNamespace:
+    return types.SimpleNamespace(**kwargs)
+
+
+# Sentinel: makes Watch.stream return (stream end -> adapter reconnects).
+STREAM_END = object()
+
+
+class FakeStore:
+    """API-server state + fault injection shared by CoreV1Api and Watch."""
+
+    def __init__(self) -> None:
+        self.pods = {}  # (ns, name) -> object shaped like V1Pod
+        self.nodes = {}  # name -> object shaped like V1Node
+        self.bindings = []  # (ns, name, node) from the bind subresource
+        self.resource_version = 0
+        # fault injection
+        self.patch_conflicts_remaining = 0  # first N patches raise 409
+        self.patch_calls = 0
+        # watch plumbing
+        self.watch_feed = queue.Queue()  # (TYPE, obj) | Exception | STREAM_END
+        self.watch_stream_kwargs = []  # kwargs of each stream(...) call
+        self.list_calls = 0
+
+    # ---- object builders ---------------------------------------------
+    def put_pod(self, namespace: str, name: str, labels: Optional[dict] = None,
+                annotations: Optional[dict] = None, node_name: str = "",
+                env: Optional[dict] = None, phase: str = "Pending",
+                scheduler_name: str = "kubeshare-scheduler"):
+        self.resource_version += 1
+        obj = _ns(
+            metadata=_ns(
+                namespace=namespace, name=name, uid=f"uid-{namespace}-{name}",
+                labels=dict(labels or {}), annotations=dict(annotations or {}),
+                creation_timestamp=None,
+                resource_version=str(self.resource_version),
+            ),
+            spec=_ns(
+                scheduler_name=scheduler_name, node_name=node_name,
+                containers=[_ns(
+                    name="main",
+                    env=[_ns(name=k, value=v) for k, v in (env or {}).items()],
+                    volume_mounts=[],
+                )],
+                volumes=[],
+            ),
+            status=_ns(phase=phase),
+        )
+        self.pods[(namespace, name)] = obj
+        return obj
+
+    def put_node(self, name: str, ready: bool = True,
+                 labels: Optional[dict] = None, unschedulable: bool = False):
+        self.resource_version += 1
+        obj = _ns(
+            metadata=_ns(name=name, labels=dict(labels or {}),
+                         resource_version=str(self.resource_version)),
+            spec=_ns(unschedulable=unschedulable),
+            status=_ns(conditions=[
+                _ns(type="Ready", status="True" if ready else "False"),
+            ]),
+        )
+        self.nodes[name] = obj
+        return obj
+
+    # ---- watch feed helpers ------------------------------------------
+    def emit(self, event_type: str, obj) -> None:
+        self.watch_feed.put((event_type, obj))
+
+    def emit_error(self, exc: Exception) -> None:
+        self.watch_feed.put(exc)
+
+    def end_stream(self) -> None:
+        self.watch_feed.put(STREAM_END)
+
+
+class CoreV1Api:
+    def __init__(self, store: FakeStore) -> None:
+        self._store = store
+
+    # ---- reads -------------------------------------------------------
+    def list_namespaced_pod(self, namespace, label_selector=None,
+                            field_selector=None):
+        self._store.list_calls += 1
+        items = [obj for (ns, _), obj in sorted(self._store.pods.items())
+                 if ns == namespace]
+        return _ns(items=self._filter(items, label_selector, field_selector))
+
+    def list_pod_for_all_namespaces(self, label_selector=None,
+                                    field_selector=None, **kwargs):
+        self._store.list_calls += 1
+        items = [obj for _, obj in sorted(self._store.pods.items())]
+        return _ns(items=self._filter(items, label_selector, field_selector),
+                   metadata=self._list_meta())
+
+    def list_node(self, **kwargs):
+        return _ns(items=[obj for _, obj in sorted(self._store.nodes.items())],
+                   metadata=self._list_meta())
+
+    def _list_meta(self):
+        # real list responses carry the collection resourceVersion the
+        # adapter resumes its watch from after a 410 resync
+        return _ns(resource_version=str(self._store.resource_version))
+
+    def read_namespaced_pod(self, name, namespace):
+        obj = self._store.pods.get((namespace, name))
+        if obj is None:
+            raise ApiException(404, "pod not found")
+        return obj
+
+    @staticmethod
+    def _filter(items, label_selector, field_selector):
+        if label_selector:
+            wanted = dict(part.split("=", 1)
+                          for part in label_selector.split(","))
+            items = [o for o in items
+                     if all(o.metadata.labels.get(k) == v
+                            for k, v in wanted.items())]
+        if field_selector:
+            for part in field_selector.split(","):
+                key, value = part.split("=", 1)
+                if key == "status.phase":
+                    items = [o for o in items if o.status.phase == value]
+        return items
+
+    # ---- writes ------------------------------------------------------
+    def create_namespaced_pod(self, namespace, body):
+        meta = body["metadata"]
+        spec = body["spec"]
+        env = {}
+        containers = spec.get("containers") or [{}]
+        for e in containers[0].get("env") or []:
+            env[e["name"]] = e["value"]
+        return self._store.put_pod(
+            namespace, meta["name"], labels=meta.get("labels"),
+            annotations=meta.get("annotations"),
+            node_name=spec.get("nodeName") or "",
+            env=env, scheduler_name=spec.get("schedulerName") or "",
+        )
+
+    def patch_namespaced_pod(self, name, namespace, patch):
+        self._store.patch_calls += 1
+        if self._store.patch_conflicts_remaining > 0:
+            self._store.patch_conflicts_remaining -= 1
+            raise ApiException(409, "the object has been modified")
+        obj = self.read_namespaced_pod(name, namespace)
+        meta = patch.get("metadata", {})
+        # strategic-merge semantics for the maps the adapter patches
+        if "labels" in meta:
+            obj.metadata.labels.update(meta["labels"] or {})
+        if "annotations" in meta:
+            obj.metadata.annotations.update(meta["annotations"] or {})
+        self._store.resource_version += 1
+        obj.metadata.resource_version = str(self._store.resource_version)
+        return obj
+
+    def delete_namespaced_pod(self, name, namespace):
+        if (namespace, name) not in self._store.pods:
+            raise ApiException(404, "pod not found")
+        del self._store.pods[(namespace, name)]
+
+    def create_namespaced_pod_binding(self, name, namespace, body,
+                                      _preload_content=True):
+        obj = self.read_namespaced_pod(name, namespace)
+        node = body.target.name
+        obj.spec.node_name = node
+        self._store.bindings.append((namespace, name, node))
+
+
+class Watch:
+    """Replays the store's watch feed; exceptions in the feed are raised
+    into the consumer (modelling dropped connections and 410 Gone)."""
+
+    def __init__(self, store: FakeStore) -> None:
+        self._store = store
+
+    def stream(self, list_fn, **kwargs):
+        self._store.watch_stream_kwargs.append(dict(kwargs))
+        while True:
+            item = self._store.watch_feed.get()
+            if item is STREAM_END:
+                return
+            if isinstance(item, Exception):
+                raise item
+            event_type, obj = item
+            yield {"type": event_type, "object": obj}
+
+
+def install(monkeypatch, store: Optional[FakeStore] = None) -> FakeStore:
+    """Register the fake under sys.modules so `import kubernetes` (and the
+    `from kubernetes import client, config, watch` in the adapter) resolves
+    here.  Returns the backing store for state/fault manipulation."""
+    store = store or FakeStore()
+
+    client_mod = types.ModuleType("kubernetes.client")
+    client_mod.ApiException = ApiException
+    client_mod.CoreV1Api = lambda: CoreV1Api(store)
+    client_mod.V1Binding = lambda metadata, target: _ns(
+        metadata=metadata, target=target)
+    client_mod.V1ObjectMeta = lambda name: _ns(name=name)
+    client_mod.V1ObjectReference = lambda api_version, kind, name: _ns(
+        api_version=api_version, kind=kind, name=name)
+
+    config_mod = types.ModuleType("kubernetes.config")
+
+    def _no_incluster():
+        raise RuntimeError("not in cluster")
+
+    config_mod.load_incluster_config = _no_incluster
+    config_mod.load_kube_config = lambda config_file=None: None
+
+    watch_mod = types.ModuleType("kubernetes.watch")
+    watch_mod.Watch = lambda: Watch(store)
+
+    kubernetes_mod = types.ModuleType("kubernetes")
+    kubernetes_mod.client = client_mod
+    kubernetes_mod.config = config_mod
+    kubernetes_mod.watch = watch_mod
+
+    monkeypatch.setitem(__import__("sys").modules, "kubernetes", kubernetes_mod)
+    monkeypatch.setitem(__import__("sys").modules, "kubernetes.client", client_mod)
+    monkeypatch.setitem(__import__("sys").modules, "kubernetes.config", config_mod)
+    monkeypatch.setitem(__import__("sys").modules, "kubernetes.watch", watch_mod)
+    return store

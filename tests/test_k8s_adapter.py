@@ -105,3 +105,158 @@ class TestManifestConstruction:
         assert manifest["spec"]["nodeName"] == "host-a"
         env = manifest["spec"]["containers"][0]["env"]
         assert {"name": "POD_NAME", "value": "ns/p"} in env
+
+
+# ---------------------------------------------------------------------------
+# Mocked-API-server integration (VERDICT r1 #10): the real `kubernetes`
+# package is absent in this image, so the adapter runs against
+# tests/fake_kubernetes — an in-memory CoreV1Api/Watch with fault injection.
+# ---------------------------------------------------------------------------
+
+import threading
+import time
+
+import pytest
+
+import fake_kubernetes
+
+
+@pytest.fixture
+def fake_cluster(monkeypatch):
+    store = fake_kubernetes.install(monkeypatch)
+    from kubeshare_tpu.cluster.k8s import K8sCluster
+
+    return K8sCluster(), store
+
+
+class TestK8sIntegration:
+    def test_crud_round_trip(self, fake_cluster):
+        from kubeshare_tpu.cluster.api import Container, Pod
+
+        cluster, store = fake_cluster
+        pod = Pod(namespace="ns", name="p1",
+                  labels={"sharedgpu/gpu_request": "0.5"},
+                  scheduler_name="kubeshare-scheduler",
+                  containers=[Container(env={"POD_NAME": "ns/p1"})])
+        cluster.create_pod(pod)
+        listed = cluster.list_pods(namespace="ns")
+        assert [p.name for p in listed] == ["p1"]
+        assert listed[0].labels["sharedgpu/gpu_request"] == "0.5"
+        assert listed[0].containers[0].env["POD_NAME"] == "ns/p1"
+        cluster.delete_pod("ns", "p1")
+        assert cluster.get_pod("ns", "p1") is None
+        # deleting again is tolerated (404 swallowed)
+        cluster.delete_pod("ns", "p1")
+
+    def test_bind_subresource(self, fake_cluster):
+        cluster, store = fake_cluster
+        store.put_pod("ns", "p1")
+        cluster.bind_pod("ns", "p1", "node-7")
+        assert store.bindings == [("ns", "p1", "node-7")]
+        assert cluster.get_pod("ns", "p1").node_name == "node-7"
+
+    def test_update_pod_retries_conflict(self, fake_cluster):
+        cluster, store = fake_cluster
+        store.put_pod("ns", "p1", annotations={"old": "1"})
+        store.patch_conflicts_remaining = 2  # two 409s, then success
+        pod = cluster.get_pod("ns", "p1")
+        pod.annotations["sharedgpu/cell_id"] = "rack/0/3"
+        cluster.update_pod(pod)
+        assert store.patch_calls == 3
+        obj = store.pods[("ns", "p1")]
+        assert obj.metadata.annotations["sharedgpu/cell_id"] == "rack/0/3"
+        assert obj.metadata.annotations["old"] == "1"  # merge, not replace
+
+    def test_update_pod_conflict_exhaustion_raises(self, fake_cluster):
+        cluster, store = fake_cluster
+        store.put_pod("ns", "p1")
+        store.patch_conflicts_remaining = 99
+        pod = cluster.get_pod("ns", "p1")
+        with pytest.raises(fake_kubernetes.ApiException) as exc:
+            cluster.update_pod(pod)
+        assert exc.value.status == 409
+        assert store.patch_calls == 4  # bounded retries
+
+    def test_update_pod_binds_when_node_assigned(self, fake_cluster):
+        cluster, store = fake_cluster
+        store.put_pod("ns", "p1")
+        pod = cluster.get_pod("ns", "p1")
+        pod.node_name = "node-3"
+        cluster.update_pod(pod)
+        assert store.bindings == [("ns", "p1", "node-3")]
+
+    def _wait_for(self, predicate, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(0.02)
+        return False
+
+    def test_watch_reconnect_resumes_from_resource_version(self, fake_cluster):
+        cluster, store = fake_cluster
+        events = []
+        lock = threading.Lock()
+
+        def handler(event_type, pod):
+            with lock:
+                events.append((event_type, pod.name))
+
+        cluster.add_pod_handler(handler)  # initial list: empty
+        obj1 = store.put_pod("ns", "w1")
+        store.emit("ADDED", obj1)
+        assert self._wait_for(lambda: ("add", "w1") in events)
+        # connection drops mid-stream; adapter must reconnect and resume
+        store.emit_error(ConnectionResetError("stream dropped"))
+        obj2 = store.put_pod("ns", "w2")
+        store.emit("MODIFIED", obj2)
+        assert self._wait_for(lambda: ("update", "w2") in events)
+        # the reconnect passed the last seen resourceVersion (no replay)
+        assert len(store.watch_stream_kwargs) >= 2
+        resumed = store.watch_stream_kwargs[-1]
+        assert resumed.get("resource_version") == obj1.metadata.resource_version
+
+    def test_watch_410_gone_resyncs_from_list(self, fake_cluster):
+        cluster, store = fake_cluster
+        events = []
+        lock = threading.Lock()
+
+        def handler(event_type, pod):
+            with lock:
+                events.append((event_type, pod.name))
+
+        cluster.add_pod_handler(handler)
+        obj1 = store.put_pod("ns", "old1")
+        store.emit("ADDED", obj1)
+        assert self._wait_for(lambda: ("add", "old1") in events)
+        # compaction: watch history gone; state changed while blind —
+        # one pod appeared AND one disappeared
+        store.put_pod("ns", "missed")
+        del store.pods[("ns", "old1")]
+        store.emit_error(fake_kubernetes.ApiException(410, "Gone"))
+        # resync surfaces the missed pod without a watch event for it...
+        assert self._wait_for(lambda: ("update", "missed") in events)
+        # ...and synthesizes the delete for the vanished one (a plain
+        # relist would leak its reservation forever)
+        assert self._wait_for(lambda: ("delete", "old1") in events)
+        # the next stream resumes from the resync list's resourceVersion,
+        # not from scratch — resuming without one snapshots at a later
+        # time, silently dropping deletes in the gap
+        assert self._wait_for(
+            lambda: store.watch_stream_kwargs
+            and store.watch_stream_kwargs[-1].get("resource_version")
+            == str(store.resource_version)
+        )
+
+    def test_watch_stream_end_reconnects(self, fake_cluster):
+        cluster, store = fake_cluster
+        events = []
+
+        def handler(event_type, pod):
+            events.append((event_type, pod.name))
+
+        cluster.add_pod_handler(handler)
+        store.end_stream()  # server closes politely (timeout_seconds)
+        obj = store.put_pod("ns", "after-end")
+        store.emit("ADDED", obj)
+        assert self._wait_for(lambda: ("add", "after-end") in events)
