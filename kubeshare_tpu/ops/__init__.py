@@ -1,9 +1,16 @@
-from .attention import attention_reference, flash_attention
+from .attention import (
+    attention_reference,
+    block_sparse_attention,
+    block_sparse_reference,
+    flash_attention,
+)
 from .ring_attention import ring_attention, ring_attention_sharded
 from .moe import MoEConfig, moe_apply, moe_init, moe_sharding_rules
 
 __all__ = [
     "attention_reference",
+    "block_sparse_attention",
+    "block_sparse_reference",
     "flash_attention",
     "ring_attention",
     "ring_attention_sharded",

@@ -105,17 +105,26 @@ def _kv_head_map(group: int, order: str):
 
 
 def _attention_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, causal: bool, block_q: int, block_k: int, n_kblocks: int,
-    window: Optional[int] = None,
+    q_ref, k_ref, v_ref, *refs, causal: bool, block_q: int, block_k: int,
+    n_kblocks: int, window: Optional[int] = None, has_mask: bool = False,
 ):
     """Flash-attention forward tile: online softmax over K blocks.
 
     Grid is (b, h, q_blocks, k_blocks) with the K axis innermost — TPU grids
     run sequentially over the trailing dimension, so the VMEM scratch
     accumulators (acc/m/l) carry across the K sweep of each Q block.
+
+    ``has_mask``: a [n_qblocks, n_kblocks] int32 block mask rides in SMEM
+    as a fourth input; blocks whose entry is 0 are skipped entirely (the
+    block-sparse path — cost scales with the mask's popcount).
     """
     import jax.experimental.pallas as pl  # local import: TPU-only dependency
+
+    if has_mask:
+        mask_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        mask_ref = None
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
 
     q_idx = pl.program_id(2)
     k_idx = pl.program_id(3)
@@ -127,8 +136,10 @@ def _attention_kernel(
         l_ref[...] = jnp.zeros_like(l_ref)
 
     # skip K blocks that cannot intersect the mask: above the diagonal
-    # (causal) and, with a sliding window, fully left of it
+    # (causal), outside the sliding window, or zeroed in the block mask
     relevant = _block_relevant(q_idx, k_idx, causal, block_q, block_k, window)
+    if mask_ref is not None:
+        relevant = jnp.logical_and(relevant, mask_ref[q_idx, k_idx] != 0)
 
     @pl.when(relevant)
     def compute():
@@ -175,9 +186,11 @@ def _flash_forward(
     interpret: bool,
     block_k: int = 1024,
     window: Optional[int] = None,
+    block_mask: Optional[jax.Array] = None,
 ):
     """Returns (out, lse) from the Pallas kernel, or (out, None) when the
-    shape falls back to the XLA reference."""
+    shape falls back to the XLA reference (never with a block_mask — the
+    caller guarantees tiling before passing one)."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -190,12 +203,14 @@ def _flash_forward(
     block_k = min(block_k, s)
     if s % block_q != 0 or s % block_k != 0:
         # static shapes only under jit: fall back rather than pad dynamically
+        assert block_mask is None, "block_mask requires block-tiling shapes"
         return attention_reference(q, k, v, causal, window), None
     n_kblocks = s // block_k
     grid = (b, h, s // block_q, n_kblocks)
     kernel = functools.partial(
         _attention_kernel, causal=causal, block_q=block_q,
         block_k=block_k, n_kblocks=n_kblocks, window=window,
+        has_mask=block_mask is not None,
     )
     # when called under a vma-checking shard_map, pallas out_shapes must
     # state their varying mesh axes explicitly (the union of the inputs');
@@ -205,6 +220,17 @@ def _flash_forward(
     # operands — but the compiled TPU path lowers to one Mosaic call and
     # checks fine with these annotations.
     vma = jax.typeof(q).vma | jax.typeof(k).vma | jax.typeof(v).vma
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d),
+                     lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, block_k, d), _kv_head_map(group, "qk")),
+        pl.BlockSpec((1, 1, block_k, d), _kv_head_map(group, "qk")),
+    ]
+    inputs = [q, k, v]
+    if block_mask is not None:
+        # whole mask in SMEM, indexed by (q_idx, k_idx) inside the kernel
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        inputs.append(block_mask.astype(jnp.int32))
     out, lse = pl.pallas_call(
         kernel,
         out_shape=(
@@ -212,12 +238,7 @@ def _flash_forward(
             jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32, vma=vma),
         ),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, d), _kv_head_map(group, "qk")),
-            pl.BlockSpec((1, 1, block_k, d), _kv_head_map(group, "qk")),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
@@ -230,7 +251,7 @@ def _flash_forward(
             pltpu.VMEM((block_q,), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
     return out, lse
 
 
@@ -251,12 +272,18 @@ def _recompute_probs(q, k, lse, q_idx, k_idx, causal, block_q, block_k,
 
 
 def _flash_bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc, *, causal, block_q, block_k, n_qblocks, window=None,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+    causal, block_q, block_k, n_qblocks, window=None, has_mask=False,
 ):
     """Sweep over Q blocks (innermost grid axis) accumulating dk, dv for one
     K block."""
     import jax.experimental.pallas as pl
+
+    if has_mask:
+        mask_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs
+    else:
+        mask_ref = None
+        dk_ref, dv_ref, dk_acc, dv_acc = refs
 
     k_idx = pl.program_id(2)
     q_idx = pl.program_id(3)
@@ -267,6 +294,8 @@ def _flash_bwd_dkv_kernel(
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     relevant = _block_relevant(q_idx, k_idx, causal, block_q, block_k, window)
+    if mask_ref is not None:
+        relevant = jnp.logical_and(relevant, mask_ref[q_idx, k_idx] != 0)
 
     @pl.when(relevant)
     def compute():
@@ -293,12 +322,18 @@ def _flash_bwd_dkv_kernel(
 
 
 def _flash_bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    dq_acc, *, causal, block_q, block_k, n_kblocks, window=None,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+    causal, block_q, block_k, n_kblocks, window=None, has_mask=False,
 ):
     """Sweep over K blocks (innermost grid axis) accumulating dq for one Q
     block."""
     import jax.experimental.pallas as pl
+
+    if has_mask:
+        mask_ref, dq_ref, dq_acc = refs
+    else:
+        mask_ref = None
+        dq_ref, dq_acc = refs
 
     q_idx = pl.program_id(2)
     k_idx = pl.program_id(3)
@@ -308,6 +343,8 @@ def _flash_bwd_dq_kernel(
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
     relevant = _block_relevant(q_idx, k_idx, causal, block_q, block_k, window)
+    if mask_ref is not None:
+        relevant = jnp.logical_and(relevant, mask_ref[q_idx, k_idx] != 0)
 
     @pl.when(relevant)
     def compute():
@@ -332,6 +369,8 @@ def _flash_bwd_dq_kernel(
 def _flash_backward(
     q, k, v, out, lse, g, causal, interpret,
     block_q: int = 256, block_k: int = 512, window: Optional[int] = None,
+    block_mask: Optional[jax.Array] = None,
+    mask_block: Optional[tuple] = None,
 ):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -341,6 +380,21 @@ def _flash_backward(
     group = h // h_kv
     block_q = min(block_q, s)
     block_k = min(block_k, s)
+    mask_input = None
+    if block_mask is not None:
+        # the mask is defined at the forward's block granularity; refine it
+        # to the backward's (smaller or equal) blocks by repetition
+        mask_bq, mask_bk = mask_block
+        block_q = min(block_q, mask_bq)
+        block_k = min(block_k, mask_bk)
+        if mask_bq % block_q or mask_bk % block_k:
+            # non-power-of-two forward blocks: run the backward at the
+            # mask's own granularity rather than mis-repeating it
+            block_q, block_k = mask_bq, mask_bk
+        mask_input = jnp.repeat(
+            jnp.repeat(block_mask.astype(jnp.int32), mask_bq // block_q, 0),
+            mask_bk // block_k, 1,
+        )
     n_qblocks = s // block_q
     n_kblocks = s // block_k
 
@@ -354,6 +408,23 @@ def _flash_backward(
 
     vma = jax.typeof(q).vma | jax.typeof(k).vma | jax.typeof(v).vma
 
+    dkv_in_specs = [
+        pl.BlockSpec((1, 1, block_q, d),
+                     lambda bi, hi, ki, qi: (bi, hi, qi, 0)),  # q
+        pl.BlockSpec((1, 1, block_k, d), _kv_head_map(group, "kq")),  # k
+        pl.BlockSpec((1, 1, block_k, d), _kv_head_map(group, "kq")),  # v
+        pl.BlockSpec((1, 1, block_q, d),
+                     lambda bi, hi, ki, qi: (bi, hi, qi, 0)),  # dO
+        pl.BlockSpec((1, 1, block_q, 1),
+                     lambda bi, hi, ki, qi: (bi, hi, qi, 0)),  # lse
+        pl.BlockSpec((1, 1, block_q, 1),
+                     lambda bi, hi, ki, qi: (bi, hi, qi, 0)),  # delta
+    ]
+    dkv_inputs = [q, k, v, g, lse, delta]
+    if mask_input is not None:
+        dkv_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        dkv_inputs.append(mask_input)
+
     # dk/dv: grid (b, h, kb, qb) — q sweeps innermost.  GQA: k/v are read
     # grouped (hi // group index map, no HBM repeat); dk/dv come out at full
     # query-head resolution and are group-reduced after the call.
@@ -361,24 +432,14 @@ def _flash_backward(
         functools.partial(
             _flash_bwd_dkv_kernel, causal=causal, block_q=block_q,
             block_k=block_k, n_qblocks=n_qblocks, window=window,
+            has_mask=mask_input is not None,
         ),
         out_shape=(
             jax.ShapeDtypeStruct((b, h, s, d), k.dtype, vma=vma),
             jax.ShapeDtypeStruct((b, h, s, d), v.dtype, vma=vma),
         ),
         grid=(b, h, n_kblocks, n_qblocks),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),  # q
-            pl.BlockSpec((1, 1, block_k, d), _kv_head_map(group, "kq")),  # k
-            pl.BlockSpec((1, 1, block_k, d), _kv_head_map(group, "kq")),  # v
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),  # dO
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),  # lse
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),  # delta
-        ],
+        in_specs=dkv_in_specs,
         out_specs=(
             pl.BlockSpec((1, 1, block_k, d),
                          lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
@@ -390,33 +451,40 @@ def _flash_backward(
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(*dkv_inputs)
     if group > 1:
         dk = dk_full.reshape(b, h_kv, group, s, d).sum(axis=2).astype(k.dtype)
         dv = dv_full.reshape(b, h_kv, group, s, d).sum(axis=2).astype(v.dtype)
     else:
         dk, dv = dk_full, dv_full
 
+    dq_in_specs = [
+        qd_spec,  # q
+        pl.BlockSpec((1, 1, block_k, d), _kv_head_map(group, "qk")),  # k
+        pl.BlockSpec((1, 1, block_k, d), _kv_head_map(group, "qk")),  # v
+        qd_spec,  # dO
+        row_spec,  # lse
+        row_spec,  # delta
+    ]
+    dq_inputs = [q, k, v, g, lse, delta]
+    if mask_input is not None:
+        dq_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        dq_inputs.append(mask_input)
+
     # dq: grid (b, h, qb, kb) — k sweeps innermost
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, causal=causal, block_q=block_q,
             block_k=block_k, n_kblocks=n_kblocks, window=window,
+            has_mask=mask_input is not None,
         ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype, vma=vma),
         grid=(b, h, n_qblocks, n_kblocks),
-        in_specs=[
-            qd_spec,  # q
-            pl.BlockSpec((1, 1, block_k, d), _kv_head_map(group, "qk")),  # k
-            pl.BlockSpec((1, 1, block_k, d), _kv_head_map(group, "qk")),  # v
-            qd_spec,  # dO
-            row_spec,  # lse
-            row_spec,  # delta
-        ],
+        in_specs=dq_in_specs,
         out_specs=qd_spec,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(*dq_inputs)
 
     return dq, dk, dv
 
@@ -483,3 +551,112 @@ def flash_attention(
     if not use_pallas:
         return attention_reference(q, k, v, causal, window)
     return _flash_attention(q, k, v, causal, block_q, interpret, window)
+
+
+# ---------------------------------------------------------------------------
+# block-sparse attention: arbitrary [n_qblocks, n_kblocks] mask
+# ---------------------------------------------------------------------------
+
+
+def block_sparse_reference(q, k, v, block_mask, causal, block_q, block_k):
+    """XLA oracle for the block-sparse kernel.  Fully-masked rows produce
+    zeros (the kernel's semantics), never NaN."""
+    if k.shape[1] != q.shape[1]:
+        if q.shape[1] % k.shape[1] != 0:
+            raise ValueError(
+                f"query heads {q.shape[1]} not a multiple of kv heads "
+                f"{k.shape[1]}"
+            )
+        group = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
+    s = q.shape[2]
+    elem = jnp.repeat(jnp.repeat(block_mask != 0, block_q, 0), block_k, 1)
+    if causal:
+        elem &= jnp.tril(jnp.ones((s, s), bool))
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    scores = jnp.where(elem, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    row_live = elem.any(axis=-1)  # all-masked rows: zero out the uniform mush
+    probs = jnp.where(row_live[None, None, :, None], probs, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _block_sparse_flash(q, k, v, block_mask, causal, block_q, block_k,
+                        interpret):
+    out, _ = _flash_forward(q, k, v, causal, block_q, interpret,
+                            block_k=block_k, block_mask=block_mask)
+    return out
+
+
+def _block_sparse_fwd(q, k, v, block_mask, causal, block_q, block_k,
+                      interpret):
+    out, lse = _flash_forward(q, k, v, causal, block_q, interpret,
+                              block_k=block_k, block_mask=block_mask)
+    return out, (q, k, v, block_mask, out, lse)
+
+
+def _block_sparse_bwd(causal, block_q, block_k, interpret, residuals, g):
+    import numpy as np
+
+    q, k, v, block_mask, out, lse = residuals
+    dq, dk, dv = _flash_backward(
+        q, k, v, out, lse, g, causal, interpret,
+        block_mask=block_mask, mask_block=(block_q, block_k),
+    )
+    # integer mask: its cotangent is the zero-sized float0
+    dmask = np.zeros(block_mask.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dmask
+
+
+_block_sparse_flash.defvjp(_block_sparse_fwd, _block_sparse_bwd)
+
+
+def block_sparse_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    block_mask: jax.Array,
+    causal: bool = False,
+    block_q: int = 512,
+    block_k: int = 1024,
+    use_pallas: Optional[bool] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Attention under an arbitrary block mask (document masking,
+    prefix-LM, dilated/strided sparsity, ...).
+
+    ``block_mask`` is [seq//block_q, seq//block_k] (int/bool): entry 0
+    masks the whole (q block, k block) tile and the kernel SKIPS it — cost
+    scales with the mask's popcount, not O(s^2).  ``causal=True``
+    additionally applies the element-level causal mask inside surviving
+    tiles.  Query rows with no unmasked keys yield zeros.
+
+    Generalizes the band-skip machinery (`_block_relevant`): the mask
+    rides in SMEM and predicates each tile; fwd + bwd kernels both skip.
+    """
+    b, h, s, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q != 0 or s % block_k != 0:
+        raise ValueError(
+            f"seq {s} must tile block_q={block_q}, block_k={block_k}"
+        )
+    block_mask = jnp.asarray(block_mask)
+    expected = (s // block_q, s // block_k)
+    if block_mask.shape != expected:
+        raise ValueError(
+            f"block_mask shape {block_mask.shape} != {expected} for "
+            f"seq {s} with blocks ({block_q}, {block_k})"
+        )
+    if use_pallas is None:
+        use_pallas = use_pallas_default(
+            jax.devices()[0].platform, s, interpret
+        )
+    if not use_pallas:
+        return block_sparse_reference(q, k, v, block_mask, causal,
+                                      block_q, block_k)
+    return _block_sparse_flash(q, k, v, block_mask.astype(jnp.int32),
+                               causal, block_q, block_k, interpret)
