@@ -14,31 +14,14 @@ from kubeshare_tpu.isolation.guard import apply_hbm_cap
 from kubeshare_tpu.runtime import ChipSupervisor, find_binary
 from kubeshare_tpu.utils.atomicfile import write_atomic
 
+from native_helpers import free_port, wait_listening
+
 TOKEND = find_binary("tpushare-tokend")
 PMGR = find_binary("tpushare-pmgr")
 
 pytestmark = pytest.mark.skipif(
     TOKEND is None or PMGR is None, reason="native binaries not built"
 )
-
-
-def free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def wait_listening(port, timeout=10.0):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        try:
-            socket.create_connection(("127.0.0.1", port), timeout=1).close()
-            return
-        except OSError:
-            time.sleep(0.05)
-    raise TimeoutError(f"nothing listening on {port}")
 
 
 def _start_tokend(tmp_path, exclusive=False, config=None):

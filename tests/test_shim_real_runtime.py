@@ -1,0 +1,176 @@
+"""Production-path validation on a REAL accelerator runtime.
+
+The reference injects its interception library into every fractional
+container (pkg/scheduler/pod.go:446-449: LD_PRELOAD=libgemhook.so.1) and the
+hook gates real CUDA work.  Our equivalent is ``libtpushim.so.1`` wrapping
+the PJRT C API of whatever plugin the process dlopens.  Round-1 verdict: the
+shim had only ever met ``native/test/fake_pjrt_plugin.cc`` — this test runs
+the full production chain against the host's real runtime:
+
+    tokend  <-TCP-  pmgr  <-TCP-  [JAX process under LD_PRELOAD=libtpushim.so.1]
+
+and asserts tokens were granted and device time charged while the process
+ran jitted matmuls on the real platform.
+
+Skips (rather than fails) when the host has no non-CPU platform — the
+in-process conftest forces CPU for every other test, but these workers are
+separate processes and initialize the host's actual backend (axon/TPU on the
+bench host).  A worker timeout under the shim triggers a control run WITHOUT
+the shim: if the control passes, the hang is the shim's fault and the test
+FAILS; if the control also hangs, the runtime itself is wedged and the test
+skips.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from kubeshare_tpu.runtime import find_binary
+from kubeshare_tpu.utils.atomicfile import write_atomic
+
+from native_helpers import free_port, wait_listening
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SHIM = os.path.join(REPO, "native", "build", "libtpushim.so.1")
+TOKEND = find_binary("tpushare-tokend")
+PMGR = find_binary("tpushare-pmgr")
+
+WORKER_TIMEOUT_S = 240.0
+
+pytestmark = pytest.mark.skipif(
+    TOKEND is None or PMGR is None or not os.path.isfile(SHIM),
+    reason="native binaries not built",
+)
+
+# What the worker runs: platform stamp, then gated jitted steps.  The step
+# count is asserted against tokend's grant counter (>= because client init /
+# warmup executions also acquire tokens).
+N_STEPS = 30
+WORKER_SRC = """
+import time, jax, jax.numpy as jnp
+print("PLATFORM", jax.devices()[0].platform, flush=True)
+x = jnp.ones((1024, 1024), jnp.bfloat16)
+f = jax.jit(lambda a: a @ a + 1)
+y = f(x); y.block_until_ready()
+for _ in range(%d):
+    y = f(y); y.block_until_ready()
+print("DONE", flush=True)
+""" % N_STEPS
+
+
+def _real_platform_env():
+    """Subprocess env for the host's REAL backend: drop the CPU forcing the
+    in-process conftest applies (JAX_PLATFORMS=cpu is only setdefault'd, but
+    XLA_FLAGS gains the 8-device host count; both are scrubbed so the worker
+    sees the machine the way a user pod would)."""
+    env = dict(os.environ)
+    if env.get("JAX_PLATFORMS") == "cpu":
+        del env["JAX_PLATFORMS"]
+    flags = env.get("XLA_FLAGS", "")
+    flags = " ".join(
+        f for f in flags.split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    if flags:
+        env["XLA_FLAGS"] = flags
+    else:
+        env.pop("XLA_FLAGS", None)
+    return env
+
+
+def _run_worker(gated_port=None, timeout=WORKER_TIMEOUT_S):
+    env = _real_platform_env()
+    if gated_port is not None:
+        env["LD_PRELOAD"] = SHIM
+        env["POD_MANAGER_PORT"] = str(gated_port)
+        env["POD_MANAGER_IP"] = "127.0.0.1"
+        env["POD_NAME"] = "shimtest/pod-a"
+    return subprocess.run(
+        [sys.executable, "-c", WORKER_SRC],
+        env=env, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def _stat(port):
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    s.sendall(b"STAT\n")
+    line = s.makefile().readline()
+    s.close()
+    return json.loads(line)
+
+
+def test_shim_gates_real_runtime(tmp_path):
+    config_dir = tmp_path / "config"
+    config_dir.mkdir()
+    uuid = "real-chip-0"
+    write_atomic(str(config_dir / uuid), "1\nshimtest/pod-a 1.0 0.5 0\n")
+
+    tokend_port = free_port()
+    tokend = subprocess.Popen(
+        [TOKEND, "-p", str(config_dir), "-f", uuid, "-P", str(tokend_port),
+         "-q", "300", "-m", "20", "-w", "10000"],
+        stderr=subprocess.DEVNULL,
+    )
+    pmgr_port = free_port()
+    pmgr = subprocess.Popen(
+        [PMGR, "-P", str(pmgr_port), "-s", "127.0.0.1",
+         "-p", str(tokend_port), "-n", "shimtest/pod-a"],
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        wait_listening(tokend_port)
+        wait_listening(pmgr_port)
+        try:
+            proc = _run_worker(gated_port=pmgr_port)
+        except subprocess.TimeoutExpired:
+            # shim hang or wedged runtime?  The control decides.
+            try:
+                control = _run_worker(gated_port=None)
+            except subprocess.TimeoutExpired:
+                pytest.skip("accelerator runtime wedged (ungated control "
+                            "run also timed out)")
+            if "DONE" in control.stdout:
+                pytest.fail("worker hung under the shim but the ungated "
+                            "control run passed: shim-induced hang")
+            pytest.skip("accelerator runtime unhealthy (control run "
+                        f"finished without DONE: {control.stdout!r})")
+
+        if "PLATFORM cpu" in proc.stdout or "PLATFORM" not in proc.stdout:
+            # either this host has no dlopen'd PJRT plugin (builtin CPU
+            # backend — nothing for the interposer to wrap) or the shim
+            # broke runtime init before the platform stamp.  The ungated
+            # control disambiguates, exactly like the timeout path.
+            try:
+                control = _run_worker(gated_port=None)
+            except subprocess.TimeoutExpired:
+                pytest.skip("accelerator runtime wedged (ungated control "
+                            "run timed out)")
+            if ("DONE" in control.stdout and "PLATFORM" in control.stdout
+                    and "PLATFORM cpu" not in control.stdout):
+                pytest.fail(
+                    f"ungated control ran fine on a real platform but the "
+                    f"gated worker did not reach it (rc={proc.returncode}, "
+                    f"stdout={proc.stdout!r}, stderr tail="
+                    f"{proc.stderr[-2000:]!r}): shim broke runtime init")
+            pytest.skip(f"no real PJRT plugin platform (worker stdout: "
+                        f"{proc.stdout!r}, rc={proc.returncode})")
+        assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+        assert "DONE" in proc.stdout
+
+        stats = _stat(tokend_port)
+        pod = stats["pods"]["shimtest/pod-a"]
+        # every gated step acquired a token through pmgr -> tokend; init and
+        # warmup executions may add more
+        assert pod["grants"] >= N_STEPS, stats
+        # completion-time charging saw real device work
+        assert pod["charged_total_ms"] > 0.0, stats
+    finally:
+        pmgr.kill()
+        pmgr.wait()
+        tokend.kill()
+        tokend.wait()
