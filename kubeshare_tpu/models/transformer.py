@@ -100,6 +100,8 @@ def _select_attention(config: TransformerConfig):
     if kind == "flash":
         return lambda q, k, v: flash_attention(q, k, v, causal=True,
                                                window=window)
+    if kind != "reference":  # ring/ulysses callers are routed before here
+        raise ValueError(f"unknown attention kind {kind!r}")
     return lambda q, k, v: attention_reference(q, k, v, causal=True,
                                                window=window)
 
@@ -163,10 +165,11 @@ def transformer_apply(
     ``attention="ring"`` needs a sequence-sharded caller — use
     ``transformer_apply_ring`` (this entry point has no mesh axis bound).
     """
-    if config.attention == "ring":
+    if config.attention in ("ring", "ulysses"):
         raise ValueError(
-            "attention='ring' shards the sequence axis; call "
-            "transformer_apply_ring(params, tokens, config, mesh) instead"
+            f"attention={config.attention!r} shards the sequence axis; call "
+            f"transformer_apply_{config.attention}(params, tokens, config, "
+            f"mesh) instead"
         )
     return _forward(params, tokens, config, _select_attention(config), 0)
 
@@ -224,6 +227,52 @@ def transformer_apply_ring(
     )(params, tokens)
 
 
+def transformer_apply_ulysses(
+    params: Dict,
+    tokens: jax.Array,
+    config: TransformerConfig,
+    mesh: Mesh,
+    batch_axis: Optional[str] = "dp",
+    seq_axis: str = "sp",
+    use_flash: Optional[bool] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Sequence-parallel forward via all-to-all (Ulysses-style) attention:
+    tokens sharded over ``seq_axis``; two ``all_to_all`` collectives swap
+    the shards to head-parallel for a FULL-sequence local attention (the
+    flash kernel at its best shapes), then swap back (ops/ulysses.py).
+
+    Unlike the ring path this supports ``attention_window`` — the local
+    attention sees the whole sequence — but needs
+    ``n_heads % mesh.shape[seq_axis] == 0``."""
+    from ..ops.ulysses import ulysses_attention
+
+    if config.n_heads % mesh.shape[seq_axis] != 0:
+        raise ValueError(
+            f"attention='ulysses' needs n_heads ({config.n_heads}) divisible "
+            f"by the {seq_axis!r} mesh degree ({mesh.shape[seq_axis]})"
+        )
+
+    def local_forward(params, tokens):
+        local_seq = tokens.shape[1]
+        offset = jax.lax.axis_index(seq_axis) * local_seq
+        attention_fn = lambda q, k, v: ulysses_attention(
+            q, k, v, axis_name=seq_axis, causal=True,
+            window=config.attention_window, use_flash=use_flash,
+            interpret=interpret,
+        )
+        return _forward(params, tokens, config, attention_fn, offset)
+
+    force_flash = use_flash if use_flash is not None else interpret
+    return jax.shard_map(
+        local_forward,
+        mesh=mesh,
+        in_specs=(P(), P(batch_axis, seq_axis)),
+        out_specs=P(batch_axis, seq_axis, None),
+        check_vma=not (force_flash and interpret),
+    )(params, tokens)
+
+
 def transformer_sharding_rules() -> Dict[str, P]:
     """Path-substring -> PartitionSpec rules over the (dp, tp, sp) mesh.
 
@@ -264,8 +313,9 @@ def transformer_apply_pipelined(
     outside the pipeline.  Requires n_layers % pp == 0."""
     from ..parallel.pipeline import pipeline_apply, stack_stage_params
 
-    if config.attention == "ring":
-        raise ValueError("pipelined path does not compose with ring yet")
+    if config.attention in ("ring", "ulysses"):
+        raise ValueError(
+            f"pipelined path does not compose with {config.attention} yet")
     n_stages = mesh.shape[pp_axis]
     if config.n_layers % n_stages != 0:
         raise ValueError(

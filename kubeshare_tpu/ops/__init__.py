@@ -5,6 +5,7 @@ from .attention import (
     flash_attention,
 )
 from .ring_attention import ring_attention, ring_attention_sharded
+from .ulysses import ulysses_attention, ulysses_attention_sharded
 from .moe import MoEConfig, moe_apply, moe_init, moe_sharding_rules
 
 __all__ = [
@@ -14,6 +15,8 @@ __all__ = [
     "flash_attention",
     "ring_attention",
     "ring_attention_sharded",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
     "MoEConfig",
     "moe_apply",
     "moe_init",

@@ -143,9 +143,13 @@ def _attention_kernel(
 
     @pl.when(relevant)
     def compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
+        # operands stay in the input dtype (bf16 on the training path):
+        # the MXU's mixed-precision mode (bf16 x bf16 -> f32 accumulate) is
+        # its full-rate path, and it is what the XLA reference's einsums
+        # feed it too.  Everything after the dot is f32.
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
         scale = q.shape[-1] ** -0.5
         scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         scores = _mask_scores(scores, q_idx, k_idx, causal, block_q, block_k,
@@ -163,7 +167,7 @@ def _attention_kernel(
         )
         l_ref[...] = l_ref[...] * correction + jnp.sum(probs, axis=-1)
         acc_ref[...] = acc_ref[...] * correction[:, None] + jnp.dot(
-            probs, v, preferred_element_type=jnp.float32
+            probs.astype(v.dtype), v, preferred_element_type=jnp.float32
         )
         m_ref[...] = m_next
 
@@ -299,20 +303,22 @@ def _flash_bwd_dkv_kernel(
 
     @pl.when(relevant)
     def compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # input-dtype MXU operands, f32 accumulators (see forward kernel)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0, :, 0]
         delta = delta_ref[0, 0, :, 0]
         scale = q.shape[-1] ** -0.5
         probs = _recompute_probs(q, k, lse, q_idx, k_idx, causal,
                                  block_q, block_k, window)
-        dv_acc[...] += jnp.dot(probs.T, do, preferred_element_type=jnp.float32)
+        dv_acc[...] += jnp.dot(probs.astype(do.dtype).T, do,
+                               preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = probs * (dp - delta[:, None])
         dk_acc[...] += scale * jnp.dot(
-            ds.T, q, preferred_element_type=jnp.float32
+            ds.astype(q.dtype).T, q, preferred_element_type=jnp.float32
         )
 
     @pl.when(q_idx == n_qblocks - 1)
@@ -348,10 +354,11 @@ def _flash_bwd_dq_kernel(
 
     @pl.when(relevant)
     def compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # input-dtype MXU operands, f32 accumulators (see forward kernel)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0, :, 0]
         delta = delta_ref[0, 0, :, 0]
         scale = q.shape[-1] ** -0.5
@@ -359,7 +366,8 @@ def _flash_bwd_dq_kernel(
                                  block_q, block_k, window)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = probs * (dp - delta[:, None])
-        dq_acc[...] += scale * jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        dq_acc[...] += scale * jnp.dot(ds.astype(k.dtype), k,
+                                       preferred_element_type=jnp.float32)
 
     @pl.when(k_idx == n_kblocks - 1)
     def finalize():

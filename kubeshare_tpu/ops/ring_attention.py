@@ -93,30 +93,27 @@ def ring_attention(
 
 
 # ---------------------------------------------------------------------------
-# Pallas-fused ring: each ring step's block attention runs the flash kernel
-# (ops.attention) instead of a whole-shard einsum, so the kernel win
-# compounds with sequence parallelism exactly where sequences are longest.
+# Hybrid flash ring: the ring decomposes each chip's causal attention into
+# per-step block partials whose mask shape is STATIC — fully visible
+# (source left of us on the ring), diagonal (our own shard: standard
+# causal), or fully masked (source right of us) — selected with lax.switch,
+# so each branch lowers with a static mask and no per-element
+# global-position math.  Partials merge by logsumexp weighting (the
+# standard flash merge).
 #
-# Per step the K/V shard's global position relative to the local Q shard is
-# one of three STATIC shapes — fully visible (source left of us on the
-# ring), diagonal (our own shard: standard causal), or fully masked
-# (source right of us) — selected with lax.switch, so each branch lowers a
-# kernel with a static mask and no per-element global-position math.
-# Partials merge by logsumexp weighting (the standard flash merge).
+# Which implementation computes each partial is chosen per mask shape from
+# v5e measurements (benchmarks/kernel_bench.py ringstep suite):
+#   - fully-visible blocks: the XLA einsum partial — with nothing to mask,
+#     XLA's fused attention runs near MXU peak (~160 TFLOPs bf16 at shard
+#     2048) and beats the flash kernel's block pipeline (~85 TFLOPs) ~2x;
+#   - diagonal blocks: the causal Pallas flash kernel — block skipping
+#     halves the work and measured 1.7x over masked XLA at s=2048;
+#   - fully-masked blocks: skipped outright.
 # ---------------------------------------------------------------------------
 
 
-def _partial_flash(q, k, v, causal: bool, interpret: bool):
-    """One block's attention partial: (normalized out, lse [b,h,s]).
-
-    Uses the Pallas flash forward (which already computes lse as the
-    backward residual); falls back to a whole-shard XLA partial when the
-    local shape doesn't tile the kernel blocks."""
-    from .attention import _flash_forward
-
-    out, lse = _flash_forward(q, k, v, causal, block_q=512, interpret=interpret)
-    if lse is not None:
-        return out.astype(jnp.float32), lse[..., 0]
+def _partial_einsum(q, k, v, causal: bool):
+    """Whole-shard XLA attention partial: (normalized out, lse [b,h,s])."""
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
@@ -131,6 +128,19 @@ def _partial_flash(q, k, v, causal: bool, interpret: bool):
     )
     out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
     return out.astype(jnp.float32), block_lse
+
+
+def _partial_flash(q, k, v, causal: bool, interpret: bool):
+    """One block's attention partial via the Pallas flash forward (which
+    already computes lse as the backward residual): (normalized out,
+    lse [b,h,s]).  Falls back to the einsum partial when the local shape
+    doesn't tile the kernel blocks."""
+    from .attention import _flash_forward
+
+    out, lse = _flash_forward(q, k, v, causal, block_q=512, interpret=interpret)
+    if lse is not None:
+        return out.astype(jnp.float32), lse[..., 0]
+    return _partial_einsum(q, k, v, causal)
 
 
 def _merge_partials(out, lse, out_blk, lse_blk):
@@ -150,14 +160,16 @@ def _ring_flash_forward(q, k, v, axis_name, causal, interpret):
 
     def block_partial(t, k_cur, v_cur):
         if not causal:
-            return _partial_flash(q, k_cur, v_cur, False, interpret)
+            # every block fully visible: the einsum partial is the measured
+            # winner (no mask for the kernel to exploit)
+            return _partial_einsum(q, k_cur, v_cur, False)
         src = (my_index - t) % axis_size
         # 0: src < my (fully visible), 1: src == my (diagonal causal),
         # 2: src > my (fully masked)
         branch = jnp.where(src == my_index, 1, jnp.where(src < my_index, 0, 2))
 
         def full(k_b, v_b):
-            return _partial_flash(q, k_b, v_b, False, interpret)
+            return _partial_einsum(q, k_b, v_b, False)
 
         def diag(k_b, v_b):
             return _partial_flash(q, k_b, v_b, True, interpret)
@@ -231,8 +243,11 @@ def ring_flash_attention(
     causal: bool = True,
     interpret: bool = False,
 ) -> jax.Array:
-    """Ring attention with Pallas flash-kernel block math (call inside
-    shard_map, like :func:`ring_attention`)."""
+    """Ring attention with the hybrid block math — causal Pallas flash
+    kernel on the diagonal step, near-peak XLA einsum partials on
+    fully-visible steps (see the measured rationale above
+    ``_partial_einsum``).  Call inside shard_map, like
+    :func:`ring_attention`."""
     return _ring_flash(q, k, v, axis_name, causal, interpret)
 
 
@@ -251,8 +266,9 @@ def ring_attention_sharded(
     """shard_map wrapper: [batch, heads, seq, head_dim] with batch over dp,
     heads over tp, and sequence over sp.
 
-    ``use_flash=None`` auto-selects the Pallas-fused ring on TPU when the
-    per-device sequence shard is long enough for the kernel to win
+    ``use_flash=None`` auto-selects the hybrid ring (causal flash kernel on
+    the diagonal step, einsum partials on fully-visible steps) on TPU when
+    the per-device sequence shard is long enough for the kernel to win
     (matching flash_attention's threshold); ``interpret=True`` forces the
     kernel path in interpret mode for CPU tests."""
     if use_flash is None:

@@ -1,0 +1,106 @@
+"""All-to-all (Ulysses-style) sequence parallelism.
+
+The second long-context strategy next to ring attention (``ops.ring_attention``):
+instead of rotating K/V shards around the ICI ring (sp-1 ``ppermute`` steps),
+two ``all_to_all`` collectives re-shard the activations from
+sequence-sharded to head-sharded, run FULL-sequence attention locally, and
+shard back:
+
+    [b, h, s/sp, d]  --all_to_all-->  [b, h/sp, s, d]
+                     local attention (Pallas flash kernel at full s)
+    [b, h/sp, s, d]  --all_to_all-->  [b, h, s/sp, d]
+
+Trade-offs vs the ring (both are first-class; pick per workload):
+  - collective count is O(1) vs O(sp) neighbor steps — wins when sp is
+    large and the per-step compute too small to hide the ppermute;
+  - the local attention sees the full sequence, so the flash kernel runs
+    at its best block shapes and *sliding-window* attention works (the
+    ring path cannot window — K/V visibility is position-dependent);
+  - requires heads % sp == 0 (head dimension is the swap currency), so
+    max sp is bounded by head count — the ring has no such bound.
+
+The reference system has no parallelism code at all (SURVEY.md §2.10); its
+north-star workloads get DP from TorchElastic.  Both strategies here are
+TPU-first: XLA lowers ``all_to_all`` onto ICI, and autodiff transposes it
+to the mirrored ``all_to_all`` — no custom VJP needed.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .attention import flash_attention
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = True,
+    window: Optional[int] = None,
+    use_flash: Optional[bool] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Call inside ``shard_map`` with the sequence axis sharded over
+    ``axis_name``; shapes are the local [batch, heads, seq/sp, head_dim].
+
+    ``use_flash`` forwards to :func:`flash_attention`'s ``use_pallas``
+    (None auto-selects the Pallas kernel on TPU at full sequence length).
+    """
+    sp = lax.psum(1, axis_name)
+    if sp == 1:
+        return flash_attention(q, k, v, causal=causal, window=window,
+                               use_pallas=use_flash, interpret=interpret)
+    h, h_kv = q.shape[1], k.shape[1]
+    if h % sp != 0 or h_kv % sp != 0:
+        raise ValueError(
+            f"ulysses needs heads divisible by the sequence-parallel degree: "
+            f"q heads {h}, kv heads {h_kv}, sp {sp}"
+        )
+    swap_in = functools.partial(
+        lax.all_to_all, axis_name=axis_name, split_axis=1, concat_axis=2,
+        tiled=True,
+    )
+    out = flash_attention(
+        swap_in(q), swap_in(k), swap_in(v), causal=causal, window=window,
+        use_pallas=use_flash, interpret=interpret,
+    )
+    return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def ulysses_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = True,
+    window: Optional[int] = None,
+    batch_axis: Optional[str] = "dp",
+    seq_axis: str = "sp",
+    head_axis: Optional[str] = "tp",
+    use_flash: Optional[bool] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """shard_map wrapper: [batch, heads, seq, head_dim] with batch over
+    ``batch_axis``, heads over ``head_axis`` and sequence over ``seq_axis``
+    (mirror of :func:`ring_attention_sharded`)."""
+    spec = P(batch_axis, head_axis, seq_axis, None)
+    fn = functools.partial(
+        ulysses_attention, axis_name=seq_axis, causal=causal, window=window,
+        use_flash=use_flash, interpret=interpret,
+    )
+    # same vma carve-out as the ring wrapper: only interpret-mode pallas
+    # evaluation trips the checker
+    force_flash = use_flash if use_flash is not None else interpret
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=not (force_flash and interpret),
+    )(q, k, v)

@@ -11,6 +11,8 @@ import os
 import socket
 import time
 
+from native_helpers import free_port, wait_listening
+
 import jax
 import jax.numpy as jnp
 import pytest
@@ -42,25 +44,6 @@ cells:
 - cellType: V4-NODE
   cellId: e2e-node
 """
-
-
-def free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def wait_listening(port, timeout=10.0):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        try:
-            socket.create_connection(("127.0.0.1", port), timeout=1).close()
-            return
-        except OSError:
-            time.sleep(0.05)
-    raise TimeoutError(f"nothing listening on {port}")
 
 
 def test_full_slice(tmp_path):
