@@ -280,8 +280,9 @@ def suite_model(iters, reps, quick=False):
           "xla_ms": round(times["reference"], 3),
           "pallas_ms": round(times["flash"], 3),
           "speedup": ratio(times["reference"], times["flash"]),
-          "pallas_tokens_per_s": int(tok_per_step / times["flash"] * 1e3),
-          "xla_tokens_per_s": int(tok_per_step / times["reference"] * 1e3)})
+          "pallas_tokens_per_s": ratio(tok_per_step * 1e3, times["flash"]),
+          "xla_tokens_per_s": ratio(tok_per_step * 1e3,
+                                    times["reference"])})
 
 
 def main():

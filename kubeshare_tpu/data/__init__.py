@@ -1,0 +1,3 @@
+from .pipeline import ShardedBatchLoader, prefetch_to_device
+
+__all__ = ["ShardedBatchLoader", "prefetch_to_device"]

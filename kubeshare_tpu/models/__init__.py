@@ -8,7 +8,7 @@ from .transformer import (
     transformer_apply_pipelined,
     transformer_sharding_rules,
 )
-from .decoding import greedy_decode, init_kv_cache, prefill
+from .decoding import greedy_decode, init_kv_cache, prefill, sample_decode
 
 __all__ = [
     "transformer_apply_ring",
@@ -17,6 +17,7 @@ __all__ = [
     "greedy_decode",
     "init_kv_cache",
     "prefill",
+    "sample_decode",
     "MnistConfig",
     "mnist_init",
     "mnist_apply",

@@ -8,7 +8,7 @@ once, the way TPU decoding must be (no growing arrays, no Python loop).
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -137,5 +137,88 @@ def greedy_decode(
     (_, _), rest = jax.lax.scan(
         step, (cache, first_token), None, length=max_new_tokens - 1
     )
+    tokens = jnp.concatenate([first_token[None], rest], axis=0)
+    return tokens.T  # [batch, new_tokens]
+
+
+def _filter_logits(
+    logits: jax.Array,
+    top_k: Optional[int],
+    top_p: Optional[float],
+) -> jax.Array:
+    """Restrict [batch, vocab] logits to the top-k / nucleus (top-p) set,
+    -inf elsewhere.  Static-shape throughout (full sort, no dynamic
+    narrowing) — the jit/TPU-compatible formulation."""
+    if top_k is not None:
+        if top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    if top_p is not None:
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]  # descending
+        cum = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+        # keep the smallest prefix whose mass reaches top_p: a token stays
+        # if the cumulative mass BEFORE it is still < top_p
+        keep_sorted = jnp.concatenate(
+            [jnp.ones_like(cum[:, :1], bool), cum[:, :-1] < top_p], axis=-1
+        )
+        # threshold back in vocab order: lowest kept logit per row
+        cutoff = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1
+        )[:, None]
+        logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
+    return logits
+
+
+def sample_decode(
+    params,
+    config: TransformerConfig,
+    prompt: jax.Array,
+    rng: jax.Array,
+    max_new_tokens: int,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> jax.Array:
+    """Sampled generation: temperature / top-k / nucleus (top-p), any
+    combination (k-restriction first, then nucleus — the conventional
+    order).  ``temperature=0`` is exact greedy.  Returns
+    [batch, max_new_tokens] token ids; jit-compatible like greedy_decode
+    (one compiled scan, static shapes, PRNG split per step)."""
+    total = prompt.shape[1] + max_new_tokens
+    if total > config.max_seq_len:
+        raise ValueError(
+            f"prompt ({prompt.shape[1]}) + max_new_tokens ({max_new_tokens}) "
+            f"= {total} exceeds max_seq_len {config.max_seq_len}"
+        )
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    # validate the filter arguments up front so temperature=0 rejects the
+    # same inputs the sampling path would
+    _filter_logits(jnp.zeros((1, 2)), top_k, top_p)
+    if temperature == 0.0:
+        return greedy_decode(params, config, prompt, max_new_tokens)
+
+    def pick(logits, key):
+        # conventional order: temperature first, then the k/nucleus
+        # restriction on the scaled distribution (top_k is scale-invariant
+        # but top_p is not)
+        filtered = _filter_logits(logits / temperature, top_k, top_p)
+        return jax.random.categorical(key, filtered, axis=-1).astype(jnp.int32)
+
+    cache, logits = prefill(params, config, prompt)
+    rng, first_key = jax.random.split(rng)
+    first_token = pick(logits, first_key)
+
+    def step(carry, key):
+        cache, token = carry
+        next_logits, cache = _decode_one(params, config, cache, token)
+        next_token = pick(next_logits, key)
+        return (cache, next_token), next_token
+
+    step_keys = jax.random.split(rng, max_new_tokens - 1)
+    (_, _), rest = jax.lax.scan(step, (cache, first_token), step_keys)
     tokens = jnp.concatenate([first_token[None], rest], axis=0)
     return tokens.T  # [batch, new_tokens]

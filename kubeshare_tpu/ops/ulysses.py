@@ -31,7 +31,6 @@ import functools
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 

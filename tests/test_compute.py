@@ -592,6 +592,83 @@ class TestDecoding:
             greedy_decode(params, config, jnp.zeros((1, 30), jnp.int32), 10)
 
 
+class TestSampledDecoding:
+    _setup = TestDecoding._setup
+
+    def test_temperature_zero_is_greedy(self):
+        from kubeshare_tpu.models.decoding import greedy_decode, sample_decode
+
+        config, params = self._setup()
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 4), 0, 64)
+        greedy = greedy_decode(params, config, prompt, max_new_tokens=8)
+        sampled = sample_decode(params, config, prompt,
+                                jax.random.PRNGKey(7), 8, temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(sampled))
+
+    def test_top_k_one_is_greedy(self):
+        from kubeshare_tpu.models.decoding import greedy_decode, sample_decode
+
+        config, params = self._setup()
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 4), 0, 64)
+        greedy = greedy_decode(params, config, prompt, max_new_tokens=6)
+        sampled = sample_decode(params, config, prompt,
+                                jax.random.PRNGKey(9), 6, temperature=1.0,
+                                top_k=1)
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(sampled))
+
+    def test_jit_deterministic_under_same_key(self):
+        from kubeshare_tpu.models.decoding import sample_decode
+
+        config, params = self._setup()
+        prompt = jax.random.randint(jax.random.PRNGKey(4), (2, 4), 0, 64)
+        decode = jax.jit(lambda p, t, r: sample_decode(
+            p, config, t, r, 8, temperature=0.8, top_k=10, top_p=0.9))
+        out1 = decode(params, prompt, jax.random.PRNGKey(5))
+        out2 = decode(params, prompt, jax.random.PRNGKey(5))
+        np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+        assert out1.shape == (2, 8)
+        assert (np.asarray(out1) >= 0).all() and (np.asarray(out1) < 64).all()
+        # a different key must be able to produce a different sequence
+        out3 = decode(params, prompt, jax.random.PRNGKey(6))
+        assert not np.array_equal(np.asarray(out1), np.asarray(out3))
+
+    def test_filter_logits_top_k(self):
+        from kubeshare_tpu.models.decoding import _filter_logits
+
+        logits = jnp.asarray([[1.0, 5.0, 3.0, 2.0]])
+        out = np.asarray(_filter_logits(logits, top_k=2, top_p=None))
+        assert np.isfinite(out[0, 1]) and np.isfinite(out[0, 2])
+        assert np.isneginf(out[0, 0]) and np.isneginf(out[0, 3])
+
+    def test_filter_logits_top_p(self):
+        from kubeshare_tpu.models.decoding import _filter_logits
+
+        # softmax of [2, 1, 0, -10] ~= [0.70, 0.26, 0.095, ~0]: top_p=0.5
+        # keeps only the first (its mass alone reaches 0.5)
+        logits = jnp.asarray([[2.0, 1.0, 0.0, -10.0]])
+        out = np.asarray(_filter_logits(logits, top_k=None, top_p=0.5))
+        assert np.isfinite(out[0, 0])
+        assert np.isneginf(out[0, 1:]).all()
+        # top_p=1.0 keeps everything
+        out = np.asarray(_filter_logits(logits, top_k=None, top_p=1.0))
+        assert np.isfinite(out).all()
+
+    def test_argument_validation(self):
+        from kubeshare_tpu.models.decoding import _filter_logits, sample_decode
+
+        config, params = self._setup()
+        with pytest.raises(ValueError):
+            sample_decode(params, config, jnp.zeros((1, 4), jnp.int32),
+                          jax.random.PRNGKey(0), 8, temperature=-1.0)
+        with pytest.raises(ValueError):
+            sample_decode(params, config, jnp.zeros((1, 30), jnp.int32),
+                          jax.random.PRNGKey(0), 10)
+        with pytest.raises(ValueError):
+            _filter_logits(jnp.zeros((1, 4)), top_k=0, top_p=None)
+        with pytest.raises(ValueError):
+            _filter_logits(jnp.zeros((1, 4)), top_k=None, top_p=1.5)
+
+
 class TestFlashKTiling:
     def test_multiple_k_blocks(self):
         from kubeshare_tpu.ops.attention import _flash_forward

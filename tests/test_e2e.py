@@ -8,7 +8,6 @@ isolation clients are the production code paths.
 """
 
 import os
-import socket
 import time
 
 from native_helpers import free_port, wait_listening
