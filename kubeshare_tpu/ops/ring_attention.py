@@ -22,7 +22,29 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def _block_scores(q, k, scale):
-    return jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    """QK^T scores, GQA-aware: with fewer K/V heads the query heads are
+    grouped over their shared KV head via a reshaped einsum — K/V are never
+    materialized at query-head width (they also rotate the ring at their
+    small width; only the per-step block math expands)."""
+    b, h, sq, d = q.shape
+    h_kv, sk = k.shape[1], k.shape[2]
+    if h == h_kv:
+        return jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if h % h_kv != 0:
+        raise ValueError(f"query heads {h} not a multiple of kv heads {h_kv}")
+    q5 = q.reshape(b, h_kv, h // h_kv, sq, d)
+    scores = jnp.einsum("bngqd,bnkd->bngqk", q5, k).astype(jnp.float32) * scale
+    return scores.reshape(b, h, sq, sk)
+
+
+def _block_pv(probs, v):
+    """probs @ V, GQA-aware (same grouping as :func:`_block_scores`)."""
+    b, h, sq, sk = probs.shape
+    h_kv, d = v.shape[1], v.shape[-1]
+    if h == h_kv:
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    p5 = probs.reshape(b, h_kv, h // h_kv, sq, sk)
+    return jnp.einsum("bngqk,bnkd->bngqd", p5, v).reshape(b, h, sq, d)
 
 
 def ring_attention(
@@ -61,8 +83,8 @@ def ring_attention(
             jnp.isfinite(m), jnp.exp(m - safe_m), 0.0
         )  # rescale old accumulators
         l = l * correction + jnp.sum(probs, axis=-1)
-        acc = acc * correction[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", probs.astype(v_cur.dtype), v_cur
+        acc = acc * correction[..., None] + _block_pv(
+            probs.astype(v_cur.dtype), v_cur
         ).astype(jnp.float32)
         return new_m, l, acc
 
@@ -113,9 +135,10 @@ def ring_attention(
 
 
 def _partial_einsum(q, k, v, causal: bool):
-    """Whole-shard XLA attention partial: (normalized out, lse [b,h,s])."""
+    """Whole-shard XLA attention partial: (normalized out, lse [b,h,s]).
+    GQA-aware via the grouped block einsums."""
     scale = q.shape[-1] ** -0.5
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    scores = _block_scores(q, k, scale)
     if causal:
         s_q, s_k = scores.shape[-2], scores.shape[-1]
         mask = jnp.arange(s_q)[:, None] >= jnp.arange(s_k)[None, :]
@@ -126,7 +149,7 @@ def _partial_einsum(q, k, v, causal: bool):
         jnp.exp(scores - jnp.where(jnp.isfinite(block_lse), block_lse, 0.0)[..., None]),
         0.0,
     )
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+    out = _block_pv(probs.astype(v.dtype), v)
     return out.astype(jnp.float32), block_lse
 
 
