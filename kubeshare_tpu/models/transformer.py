@@ -174,6 +174,29 @@ def transformer_apply(
     return _forward(params, tokens, config, _select_attention(config), 0)
 
 
+def _validate_sp_entry(
+    strategy: str, config: TransformerConfig, mesh: Mesh, seq_axis: str
+) -> None:
+    """Shared preconditions for every sequence-parallel entry point (the
+    standalone ring/ulysses forwards and the pipelined sp path)."""
+    if seq_axis not in mesh.shape:
+        raise ValueError(
+            f"sequence-parallel attention needs a {seq_axis!r} mesh axis "
+            f"(got {tuple(mesh.shape)})"
+        )
+    if strategy == "ring" and config.attention_window is not None:
+        raise ValueError(
+            "attention_window is not supported on the ring path (K/V "
+            "visibility there is ring-position-dependent); use "
+            "attention='ulysses', which composes with windows"
+        )
+    if strategy == "ulysses" and config.n_heads % mesh.shape[seq_axis] != 0:
+        raise ValueError(
+            f"attention='ulysses' needs n_heads ({config.n_heads}) divisible "
+            f"by the {seq_axis!r} mesh degree ({mesh.shape[seq_axis]})"
+        )
+
+
 def transformer_apply_ring(
     params: Dict,
     tokens: jax.Array,
@@ -191,12 +214,7 @@ def transformer_apply_ring(
     the per-device sequence shard reaches the kernel threshold (the kernel
     win then compounds with sp — exactly where sequences are longest)."""
 
-    if config.attention_window is not None:
-        raise ValueError(
-            "attention_window is not supported on the ring path yet; use "
-            "attention='flash' (windowed attention is local by nature and "
-            "rarely needs sequence parallelism)"
-        )
+    _validate_sp_entry("ring", config, mesh, seq_axis)
     if use_flash is None:
         from ..ops.ring_attention import ring_flash_auto
 
@@ -247,11 +265,7 @@ def transformer_apply_ulysses(
     ``n_heads % mesh.shape[seq_axis] == 0``."""
     from ..ops.ulysses import ulysses_attention
 
-    if config.n_heads % mesh.shape[seq_axis] != 0:
-        raise ValueError(
-            f"attention='ulysses' needs n_heads ({config.n_heads}) divisible "
-            f"by the {seq_axis!r} mesh degree ({mesh.shape[seq_axis]})"
-        )
+    _validate_sp_entry("ulysses", config, mesh, seq_axis)
 
     def local_forward(params, tokens):
         local_seq = tokens.shape[1]
@@ -307,15 +321,27 @@ def transformer_apply_pipelined(
     mesh: Mesh,
     num_microbatches: int = 2,
     pp_axis: str = "pp",
+    seq_axis: str = "sp",
+    use_flash: Optional[bool] = None,
+    interpret: bool = False,
 ) -> jax.Array:
     """Pipeline-parallel forward: layers split into pp stages (GPipe over
     ``pp_axis``, parallel.pipeline); embedding and head run replicated
-    outside the pipeline.  Requires n_layers % pp == 0."""
+    outside the pipeline.  Requires n_layers % pp == 0.
+
+    **pp x sp composition**: with ``attention="ring"`` or ``"ulysses"``
+    (and ``seq_axis`` in the mesh), activations flow through the pipeline
+    sequence-sharded — each stage runs its sequence-parallel attention
+    over ``seq_axis`` internally while microbatches hop stages over
+    ``pp_axis``.  The long-context strategies compose with pipeline depth
+    instead of competing with it.  ``use_flash=None`` auto-selects the
+    Pallas-fused bodies exactly like the standalone sp entry points
+    (ring_flash_auto / the kernel threshold at full sequence)."""
     from ..parallel.pipeline import pipeline_apply, stack_stage_params
 
-    if config.attention in ("ring", "ulysses"):
-        raise ValueError(
-            f"pipelined path does not compose with {config.attention} yet")
+    sp_attention = config.attention in ("ring", "ulysses")
+    if sp_attention:
+        _validate_sp_entry(config.attention, config, mesh, seq_axis)
     n_stages = mesh.shape[pp_axis]
     if config.n_layers % n_stages != 0:
         raise ValueError(
@@ -323,9 +349,7 @@ def transformer_apply_pipelined(
         )
     per_stage = config.n_layers // n_stages
     dtype = config.dtype
-    attention_fn = _select_attention(config)
     use_rope = config.positional == "rope"
-    positions = rope_positions(tokens.shape[1], 0) if use_rope else None
 
     x = params["embed"][tokens].astype(dtype)
     if not use_rope:
@@ -339,13 +363,58 @@ def transformer_apply_pipelined(
     ]
     stacked = stack_stage_params(stages)
 
-    def stage_fn(stage_layers, x):
-        def body(x, layer):
-            return _layer_forward(layer, x, attention_fn, dtype, positions), None
+    if sp_attention:
+        from ..ops.ring_attention import ring_flash_auto
+        from ..ops.ulysses import ulysses_attention
 
-        x, _ = jax.lax.scan(body, x, stage_layers)
-        return x
+        ring_use_flash = use_flash
+        if config.attention == "ring" and ring_use_flash is None:
+            ring_use_flash = ring_flash_auto(tokens.shape[1], mesh, seq_axis,
+                                             interpret)
 
-    x = pipeline_apply(stacked, x, stage_fn, mesh, num_microbatches, pp_axis)
+        def stage_fn(stage_layers, x):
+            # inside shard_map over (pp, sp): x is the local sequence shard
+            local_seq = x.shape[1]
+            offset = jax.lax.axis_index(seq_axis) * local_seq
+            pos = rope_positions(local_seq, offset) if use_rope else None
+            if config.attention == "ring":
+                fn = ring_flash_attention if ring_use_flash else ring_attention
+                kwargs = {"interpret": interpret} if ring_use_flash else {}
+                attn = lambda q, k, v: fn(
+                    q, k, v, axis_name=seq_axis, causal=True, **kwargs)
+            else:
+                attn = lambda q, k, v: ulysses_attention(
+                    q, k, v, axis_name=seq_axis, causal=True,
+                    window=config.attention_window, use_flash=use_flash,
+                    interpret=interpret)
+
+            def body(x, layer):
+                return _layer_forward(layer, x, attn, dtype, pos), None
+
+            x, _ = jax.lax.scan(body, x, stage_layers)
+            return x
+
+        activation_spec = P(None, seq_axis, None)
+        force_flash = (ring_use_flash if config.attention == "ring"
+                       else (use_flash if use_flash is not None else interpret))
+        stage_check_vma = not (force_flash and interpret)
+    else:
+        positions = rope_positions(tokens.shape[1], 0) if use_rope else None
+        attention_fn = _select_attention(config)
+
+        def stage_fn(stage_layers, x):
+            def body(x, layer):
+                return _layer_forward(layer, x, attention_fn, dtype,
+                                      positions), None
+
+            x, _ = jax.lax.scan(body, x, stage_layers)
+            return x
+
+        activation_spec = None
+        stage_check_vma = True
+
+    x = pipeline_apply(stacked, x, stage_fn, mesh, num_microbatches, pp_axis,
+                       activation_spec=activation_spec,
+                       check_vma=stage_check_vma)
     x = _rms_norm(x, params["final_norm"]["scale"])
     return (x @ params["lm_head"].astype(dtype)).astype(jnp.float32)

@@ -32,13 +32,41 @@ def pipeline_apply(
     mesh: Mesh,
     num_microbatches: int,
     pp_axis: str = "pp",
+    activation_spec: "P | None" = None,
+    check_vma: bool = True,
 ) -> jax.Array:
     """Run x [batch, ...] through pp stages with microbatch pipelining.
 
     ``stage_params`` leaves have a leading axis of size pp (one slice per
     stage), sharded P(pp_axis, ...); the batch divides into
     ``num_microbatches``.
+
+    ``activation_spec`` shards the activations over OTHER mesh axes (it
+    must not mention ``pp_axis``) — e.g. ``P(None, "sp", None)`` runs each
+    stage on sequence shards so the stage body can use ring/Ulysses
+    attention over ``sp`` *inside* the pipeline (pp x sp composition: the
+    stage-to-stage ppermute over pp moves each sp shard to its same-sp
+    neighbor, and the attention collectives run over sp within a stage).
     """
+    if activation_spec is not None:
+        named = [
+            name
+            for entry in activation_spec
+            if entry is not None
+            for name in ((entry,) if isinstance(entry, str) else entry)
+        ]
+        if pp_axis in named:
+            raise ValueError(
+                f"activation_spec {activation_spec} must not shard over the "
+                f"pipeline axis {pp_axis!r} (activations are replicated over "
+                "pp and hop via ppermute)"
+            )
+        if len(activation_spec) > 0 and activation_spec[0] is not None:
+            raise ValueError(
+                f"activation_spec {activation_spec} must not shard dim 0 — "
+                "the microbatch split happens inside the stages on the "
+                "global batch"
+            )
     n_stages = mesh.shape[pp_axis]
     if x.shape[0] % num_microbatches != 0:
         raise ValueError(
@@ -102,11 +130,16 @@ def pipeline_apply(
         )
         return last
 
+    x_spec = activation_spec if activation_spec is not None else P()
+    # check_vma=False is only for interpret-mode pallas stage bodies (their
+    # block slicing mixes varying/invariant operands); compiled paths keep
+    # full checking
     return jax.shard_map(
         staged,
         mesh=mesh,
-        in_specs=(param_specs, P()),
-        out_specs=P(),
+        in_specs=(param_specs, x_spec),
+        out_specs=x_spec,
+        check_vma=check_vma,
     )(stage_params, x)
 
 

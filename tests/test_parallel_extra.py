@@ -271,3 +271,116 @@ class TestPipelinedTransformer:
         flat = jax.tree.leaves(grads)
         assert all(np.isfinite(np.asarray(g)).all() for g in flat)
         assert sum(float(np.abs(np.asarray(g)).sum()) for g in flat) > 0
+
+
+class TestPipelineSequenceParallel:
+    """pp x sp composition: sequence-parallel attention (ring / Ulysses)
+    running INSIDE pipeline stages — activations flow sequence-sharded,
+    microbatches hop stages over pp, attention collectives run over sp."""
+
+    def _mesh(self, pp=2, sp=4):
+        devices = np.array(jax.devices()[:pp * sp]).reshape(pp, sp)
+        return Mesh(devices, ("pp", "sp"))
+
+    def _config(self, attention, **kw):
+        from kubeshare_tpu.models.transformer import TransformerConfig
+
+        return TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq_len=64, dtype=jnp.float32, attention=attention,
+            positional="rope", **kw)
+
+    def _check_matches_dense(self, attention, **kw):
+        from dataclasses import replace
+
+        from kubeshare_tpu.models.transformer import (
+            transformer_apply, transformer_apply_pipelined, transformer_init)
+
+        mesh = self._mesh()
+        config = self._config(attention, **kw)
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 64)
+        dense = transformer_apply(
+            params, tokens, replace(config, attention="reference"))
+        piped = transformer_apply_pipelined(
+            params, tokens, config, mesh, num_microbatches=2)
+        np.testing.assert_allclose(np.asarray(dense), np.asarray(piped),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_ring_in_pipeline_matches_dense(self):
+        self._check_matches_dense("ring")
+
+    def test_ulysses_in_pipeline_matches_dense(self):
+        self._check_matches_dense("ulysses")
+
+    def test_windowed_ulysses_in_pipeline(self):
+        from dataclasses import replace
+
+        from kubeshare_tpu.models.transformer import (
+            transformer_apply, transformer_apply_pipelined, transformer_init)
+
+        mesh = self._mesh()
+        config = self._config("ulysses", attention_window=8)
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (4, 32), 0, 64)
+        dense = transformer_apply(
+            params, tokens, replace(config, attention="reference"))
+        piped = transformer_apply_pipelined(
+            params, tokens, config, mesh, num_microbatches=2)
+        np.testing.assert_allclose(np.asarray(dense), np.asarray(piped),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_grads_flow_through_pp_sp(self):
+        from kubeshare_tpu.models.transformer import (
+            transformer_apply_pipelined, transformer_init)
+
+        mesh = self._mesh()
+        config = self._config("ring")
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        tokens = jnp.ones((4, 32), jnp.int32)
+        grads = jax.grad(lambda p: transformer_apply_pipelined(
+            p, tokens, config, mesh, num_microbatches=2).sum())(params)
+        flat = jax.tree_util.tree_leaves(grads)
+        assert all(np.isfinite(np.asarray(g)).all() for g in flat)
+        assert any(np.abs(np.asarray(g)).sum() > 0 for g in flat)
+
+    def test_missing_sp_axis_raises(self):
+        from kubeshare_tpu.models.transformer import (
+            transformer_apply_pipelined, transformer_init)
+
+        devices = np.array(jax.devices()[:2]).reshape(2)
+        mesh = Mesh(devices, ("pp",))
+        config = self._config("ring")
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        with pytest.raises(ValueError, match="mesh axis"):
+            transformer_apply_pipelined(params, jnp.ones((2, 16), jnp.int32),
+                                        config, mesh)
+
+    def test_activation_spec_rejects_pp(self):
+        mesh = self._mesh()
+        stage_params = {"w": jnp.zeros((2, 4, 4))}
+        with pytest.raises(ValueError, match="must not shard"):
+            pipeline_apply(stage_params, jnp.zeros((4, 8, 4)),
+                           lambda p, x: x, mesh, 2,
+                           activation_spec=P("pp", None, None))
+
+
+    def test_ring_flash_in_pipeline_matches_dense(self):
+        """The Pallas-fused ring body (interpret mode) inside pipeline
+        stages — the pp x sp kernel path."""
+        from dataclasses import replace
+
+        from kubeshare_tpu.models.transformer import (
+            transformer_apply, transformer_apply_pipelined, transformer_init)
+
+        mesh = self._mesh()
+        config = self._config("ring")
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        tokens = jax.random.randint(jax.random.PRNGKey(3), (4, 32), 0, 64)
+        dense = transformer_apply(
+            params, tokens, replace(config, attention="reference"))
+        piped = transformer_apply_pipelined(
+            params, tokens, config, mesh, num_microbatches=2,
+            use_flash=True, interpret=True)
+        np.testing.assert_allclose(np.asarray(dense), np.asarray(piped),
+                                   rtol=2e-4, atol=2e-4)
