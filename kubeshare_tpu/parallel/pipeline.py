@@ -25,6 +25,33 @@ from jax.sharding import Mesh, PartitionSpec as P
 StageFn = Callable[[Any, jax.Array], jax.Array]
 
 
+
+def _validate_activation_spec(activation_spec, pp_axis: str) -> tuple:
+    """Validate an activation PartitionSpec for the pipelined entry points
+    and return the tuple of mesh-axis names it shards over."""
+    if activation_spec is None:
+        return ()
+    named = tuple(
+        name
+        for entry in activation_spec
+        if entry is not None
+        for name in ((entry,) if isinstance(entry, str) else entry)
+    )
+    if pp_axis in named:
+        raise ValueError(
+            f"activation_spec {activation_spec} must not shard over the "
+            f"pipeline axis {pp_axis!r} (activations are replicated over "
+            "pp and hop via ppermute)"
+        )
+    if len(activation_spec) > 0 and activation_spec[0] is not None:
+        raise ValueError(
+            f"activation_spec {activation_spec} must not shard dim 0 — "
+            "the microbatch split happens inside the stages on the "
+            "global batch"
+        )
+    return named
+
+
 def pipeline_apply(
     stage_params: Any,
     x: jax.Array,
@@ -48,25 +75,7 @@ def pipeline_apply(
     stage-to-stage ppermute over pp moves each sp shard to its same-sp
     neighbor, and the attention collectives run over sp within a stage).
     """
-    if activation_spec is not None:
-        named = [
-            name
-            for entry in activation_spec
-            if entry is not None
-            for name in ((entry,) if isinstance(entry, str) else entry)
-        ]
-        if pp_axis in named:
-            raise ValueError(
-                f"activation_spec {activation_spec} must not shard over the "
-                f"pipeline axis {pp_axis!r} (activations are replicated over "
-                "pp and hop via ppermute)"
-            )
-        if len(activation_spec) > 0 and activation_spec[0] is not None:
-            raise ValueError(
-                f"activation_spec {activation_spec} must not shard dim 0 — "
-                "the microbatch split happens inside the stages on the "
-                "global batch"
-            )
+    _validate_activation_spec(activation_spec, pp_axis)
     n_stages = mesh.shape[pp_axis]
     if x.shape[0] % num_microbatches != 0:
         raise ValueError(
@@ -182,6 +191,9 @@ def pipeline_train_1f1b(
     mesh: Mesh,
     num_microbatches: int,
     pp_axis: str = "pp",
+    activation_spec: "P | None" = None,
+    target_spec: "P | None" = None,
+    check_vma: bool = True,
 ):
     """One pipelined training step under the 1F1B schedule.
 
@@ -191,8 +203,22 @@ def pipeline_train_1f1b(
     that stage's gradients).  Gradient-equivalent to
     ``jax.grad`` over :func:`pipeline_apply` (same math, different
     schedule); activation memory is O(stages), not O(microbatches).
+
+    ``activation_spec`` composes 1F1B with sequence parallelism exactly
+    like :func:`pipeline_apply`: x/y flow sequence-sharded, the stage body
+    runs its sp collectives internally, per-shard losses are pmean'd and
+    per-shard param grads psum'd over the sharded axes (same contract as
+    data parallelism; requires ``loss_fn`` to be a mean over the sharded
+    axis, like cross-entropy over tokens).
     """
     n_stages = mesh.shape[pp_axis]
+    extra_axes = _validate_activation_spec(activation_spec, pp_axis)
+    if extra_axes and not check_vma:
+        raise ValueError(
+            "activation_spec with check_vma=False is unsupported: the "
+            "sharded-axis gradient reduction relies on vma-typed "
+            "autodiff psum-ing the invariant params' cotangents"
+        )
     if x.shape[0] % num_microbatches != 0:
         raise ValueError(
             f"batch {x.shape[0]} not divisible into {num_microbatches} microbatches"
@@ -218,7 +244,12 @@ def pipeline_train_1f1b(
         bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
         n_ticks = num_microbatches + 2 * (n_stages - 1)
 
-        varying_zero = (stage * 0).astype(micro_x.dtype)
+        # carries and cotangent seeds must be device-varying over pp AND
+        # any activation-sharded axes (the loss/vjp outputs carry them)
+        varying_idx = stage
+        for ax in extra_axes:
+            varying_idx = varying_idx + jax.lax.axis_index(ax)
+        varying_zero = (varying_idx * 0).astype(micro_x.dtype)
 
         def stage_out_shape():
             probe = jax.eval_shape(
@@ -231,9 +262,14 @@ def pipeline_train_1f1b(
         fwd_carry0 = jnp.zeros(out_shape, out_dtype) + varying_zero.astype(out_dtype)
         bwd_carry0 = jnp.zeros(out_shape, jnp.float32) + varying_zero.astype(jnp.float32)
         stash0 = jnp.zeros((slots, *micro_x.shape[1:]), micro_x.dtype) + varying_zero
+        # grads stay varying over pp ONLY: the params are invariant over
+        # the activation-sharded axes, so their cotangents come back
+        # already reduced (sp-invariant) from jax.vjp — seeding the
+        # accumulator sp-varying would force an sp-varying sum type and
+        # fail the P(pp) out_specs replication check
+        pp_zero = (stage * 0).astype(jnp.float32)
         grads0 = jax.tree.map(
-            lambda p: jnp.zeros(p.shape, jnp.float32)
-            + varying_zero.astype(jnp.float32),
+            lambda p: jnp.zeros(p.shape, jnp.float32) + pp_zero,
             local_params,
         )
         loss0 = jnp.zeros((), jnp.float32) + varying_zero.astype(jnp.float32)
@@ -300,6 +336,19 @@ def pipeline_train_1f1b(
         )
         # loss lives on the last stage; share it
         loss = jax.lax.psum(loss_sum, pp_axis)
+        if extra_axes:
+            # sequence-sharded stages: each shard's loss_fn is a mean over
+            # its LOCAL tokens, over-weighting every token by the shard
+            # count.  The params are invariant over the sharded axes, so
+            # vma-typed autodiff has ALREADY psum'd their cotangents across
+            # shards inside jax.vjp (verified; this is why check_vma=False
+            # is rejected above) — the only correction left is dividing
+            # out the local-mean over-weight.
+            loss = jax.lax.pmean(loss, extra_axes)
+            denom = 1
+            for ax in extra_axes:
+                denom = denom * jax.lax.psum(1, ax)
+            grads = jax.tree.map(lambda g: g / denom, grads)
         # grads: each stage keeps its own (restack leading axis of 1),
         # cast back to the param dtype so updates don't silently promote
         grads = jax.tree.map(
@@ -307,9 +356,20 @@ def pipeline_train_1f1b(
         )
         return loss, grads
 
+    x_spec = activation_spec if activation_spec is not None else P()
+    # y may have a different rank than x (e.g. [batch, seq] targets vs
+    # [batch, seq, d] activations): the default truncates the activation
+    # spec to y's rank; pass target_spec for anything fancier
+    if target_spec is not None:
+        y_spec = target_spec
+    elif activation_spec is not None:
+        y_spec = P(*tuple(activation_spec)[:y.ndim])
+    else:
+        y_spec = P()
     return jax.shard_map(
         staged,
         mesh=mesh,
-        in_specs=(param_specs, P(), P()),
+        in_specs=(param_specs, x_spec, y_spec),
         out_specs=(P(), param_specs),  # grads shard exactly like params
+        check_vma=check_vma,
     )(stage_params, x, y)
