@@ -4,6 +4,7 @@ from .transformer import (
     TransformerConfig,
     transformer_init,
     transformer_apply,
+    transformer_apply_with_aux,
     transformer_apply_ring,
     transformer_apply_pipelined,
     transformer_sharding_rules,
@@ -27,4 +28,5 @@ __all__ = [
     "TransformerConfig",
     "transformer_init",
     "transformer_apply",
+    "transformer_apply_with_aux",
 ]

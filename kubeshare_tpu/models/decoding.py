@@ -78,8 +78,22 @@ def _decode_one(params, config: TransformerConfig, cache: Dict, token: jax.Array
         ).astype(dtype)
         x = x + jnp.einsum("bhsk,hkd->bsd", o, layer["attn"]["wo"].astype(dtype))
         y = _rms_norm(x, layer["norm2"]["scale"])
-        y = jax.nn.gelu(y @ layer["mlp"]["w_in"].astype(dtype))
-        x = x + y @ layer["mlp"]["w_out"].astype(dtype)
+        if "moe" in layer:
+            # single-token MoE step: routing is per-token (top-1 argmax),
+            # so incremental decode matches the full forward as long as
+            # capacity never drops tokens (config.moe_capacity_factor)
+            from ..ops.moe import MoEConfig, moe_apply
+
+            e, d_m, f = layer["moe"]["w_in"].shape
+            out, _ = moe_apply(
+                layer["moe"], y,
+                MoEConfig(d_model=d_m, d_ff=f, num_experts=e,
+                          capacity_factor=config.moe_capacity_factor),
+            )
+            x = x + out.astype(dtype)
+        else:
+            y = jax.nn.gelu(y @ layer["mlp"]["w_in"].astype(dtype))
+            x = x + y @ layer["mlp"]["w_out"].astype(dtype)
 
     x = _rms_norm(x, params["final_norm"]["scale"])
     logits = (x[:, 0] @ params["lm_head"].astype(dtype)).astype(jnp.float32)

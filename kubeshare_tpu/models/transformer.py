@@ -38,6 +38,15 @@ class TransformerConfig:
     attention_window: Optional[int] = None  # sliding-window (local) size
     positional: str = "learned"  # learned | rope
     remat: bool = False  # jax.checkpoint each layer (HBM for FLOPs)
+    # MoE: every Nth layer's MLP becomes a top-1-routed expert mixture
+    # (ops.moe dense dispatch); None = all-dense
+    moe_every: Optional[int] = None
+    moe_num_experts: int = 8
+    moe_capacity_factor: float = 1.25
+
+    def layer_is_moe(self, layer_idx: int) -> bool:
+        return (self.moe_every is not None
+                and layer_idx % self.moe_every == self.moe_every - 1)
 
     @property
     def head_dim(self) -> int:
@@ -45,7 +54,9 @@ class TransformerConfig:
 
 
 def transformer_init(rng: jax.Array, config: TransformerConfig) -> Dict:
-    n = 4 + 6 * config.n_layers
+    if config.moe_every is not None and config.moe_every < 1:
+        raise ValueError(f"moe_every must be >= 1, got {config.moe_every}")
+    n = 4 + 7 * config.n_layers
     keys = iter(jax.random.split(rng, n))
     d, h, f = config.d_model, config.n_heads, config.d_ff
     hd = config.head_dim
@@ -67,23 +78,32 @@ def transformer_init(rng: jax.Array, config: TransformerConfig) -> Dict:
         # rope configs skip the table entirely (at long max_seq_len it would
         # be dead weight in params, optimizer state, and checkpoints)
         params["pos_embed"] = dense(next(keys), (config.max_seq_len, d), d)
-    for _ in range(config.n_layers):
-        params["layers"].append(
-            {
-                "attn": {
-                    "wq": dense(next(keys), (d, h, hd), d),
-                    "wk": dense(next(keys), (d, h, hd), d),
-                    "wv": dense(next(keys), (d, h, hd), d),
-                    "wo": dense(next(keys), (h, hd, d), d),
-                },
-                "mlp": {
-                    "w_in": dense(next(keys), (d, f), d),
-                    "w_out": dense(next(keys), (f, d), f),
-                },
-                "norm1": {"scale": jnp.ones((d,))},
-                "norm2": {"scale": jnp.ones((d,))},
+    for i in range(config.n_layers):
+        layer = {
+            "attn": {
+                "wq": dense(next(keys), (d, h, hd), d),
+                "wk": dense(next(keys), (d, h, hd), d),
+                "wv": dense(next(keys), (d, h, hd), d),
+                "wo": dense(next(keys), (h, hd, d), d),
+            },
+            "norm1": {"scale": jnp.ones((d,))},
+            "norm2": {"scale": jnp.ones((d,))},
+        }
+        if config.layer_is_moe(i):
+            from ..ops.moe import MoEConfig, moe_init
+
+            layer["moe"] = moe_init(
+                next(keys),
+                MoEConfig(d_model=d, d_ff=f,
+                          num_experts=config.moe_num_experts,
+                          capacity_factor=config.moe_capacity_factor),
+            )
+        else:
+            layer["mlp"] = {
+                "w_in": dense(next(keys), (d, f), d),
+                "w_out": dense(next(keys), (f, d), f),
             }
-        )
+        params["layers"].append(layer)
     return params
 
 
@@ -127,17 +147,23 @@ def _forward(params, tokens, config, attention_fn, pos_offset):
         # rematerialize each layer's activations in the backward pass —
         # the standard HBM-for-FLOPs trade for long sequences / deep stacks
         layer_fn = jax.checkpoint(
-            _layer_forward, static_argnums=(2, 3)
+            _layer_forward, static_argnums=(2, 3, 5)
         )
+    aux_total = jnp.float32(0.0)
     for layer in params["layers"]:
-        x = layer_fn(layer, x, attention_fn, dtype,
-                     positions if use_rope else None)
+        x, aux = layer_fn(layer, x, attention_fn, dtype,
+                          positions if use_rope else None,
+                          config.moe_capacity_factor)
+        aux_total = aux_total + aux
 
     x = _rms_norm(x, params["final_norm"]["scale"])
-    return (x @ params["lm_head"].astype(dtype)).astype(jnp.float32)
+    return (x @ params["lm_head"].astype(dtype)).astype(jnp.float32), aux_total
 
 
-def _layer_forward(layer, x, attention_fn, dtype, rope_positions_or_none):
+def _layer_forward(layer, x, attention_fn, dtype, rope_positions_or_none,
+                   moe_capacity_factor: float = 1.25):
+    """One transformer layer; returns (x, aux) where aux is the MoE
+    load-balancing loss (0.0 for dense-MLP layers)."""
     # attention block
     y = _rms_norm(x, layer["norm1"]["scale"])
     q = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wq"].astype(dtype))
@@ -148,10 +174,20 @@ def _layer_forward(layer, x, attention_fn, dtype, rope_positions_or_none):
         k = apply_rope(k, rope_positions_or_none)
     o = attention_fn(q, k, v).astype(dtype)
     x = x + jnp.einsum("bhsk,hkd->bsd", o, layer["attn"]["wo"].astype(dtype))
-    # mlp block
+    # mlp / moe block
     y = _rms_norm(x, layer["norm2"]["scale"])
+    if "moe" in layer:
+        from ..ops.moe import MoEConfig, moe_apply
+
+        e, d, f = layer["moe"]["w_in"].shape
+        out, aux = moe_apply(
+            layer["moe"], y,
+            MoEConfig(d_model=d, d_ff=f, num_experts=e,
+                      capacity_factor=moe_capacity_factor),
+        )
+        return x + out.astype(dtype), aux
     y = jax.nn.gelu(y @ layer["mlp"]["w_in"].astype(dtype))
-    return x + y @ layer["mlp"]["w_out"].astype(dtype)
+    return x + y @ layer["mlp"]["w_out"].astype(dtype), jnp.float32(0.0)
 
 
 def transformer_apply(
@@ -171,6 +207,21 @@ def transformer_apply(
             f"transformer_apply_{config.attention}(params, tokens, config, "
             f"mesh) instead"
         )
+    logits, _ = _forward(params, tokens, config, _select_attention(config), 0)
+    return logits
+
+
+def transformer_apply_with_aux(
+    params: Dict,
+    tokens: jax.Array,
+    config: TransformerConfig,
+):
+    """Like :func:`transformer_apply` but also returns the summed MoE
+    load-balancing auxiliary loss (0.0 for all-dense configs) — add it to
+    the training loss with a small coefficient (conventionally 1e-2)."""
+    if config.attention in ("ring", "ulysses"):
+        raise ValueError(
+            f"attention={config.attention!r} shards the sequence axis")
     return _forward(params, tokens, config, _select_attention(config), 0)
 
 
@@ -194,6 +245,12 @@ def _validate_sp_entry(
         raise ValueError(
             f"attention='ulysses' needs n_heads ({config.n_heads}) divisible "
             f"by the {seq_axis!r} mesh degree ({mesh.shape[seq_axis]})"
+        )
+    if config.moe_every is not None:
+        raise ValueError(
+            "MoE layers are not supported on the sequence-parallel / "
+            "pipelined paths yet (per-shard routing capacity and aux-loss "
+            "reduction need their own design); use the dense entry points"
         )
 
 
@@ -231,7 +288,8 @@ def transformer_apply_ring(
             attention_fn = lambda q, k, v: ring_attention(
                 q, k, v, axis_name=seq_axis, causal=True
             )
-        return _forward(params, tokens, config, attention_fn, offset)
+        logits, _ = _forward(params, tokens, config, attention_fn, offset)
+        return logits
 
     return jax.shard_map(
         local_forward,
@@ -275,7 +333,8 @@ def transformer_apply_ulysses(
             window=config.attention_window, use_flash=use_flash,
             interpret=interpret,
         )
-        return _forward(params, tokens, config, attention_fn, offset)
+        logits, _ = _forward(params, tokens, config, attention_fn, offset)
+        return logits
 
     force_flash = use_flash if use_flash is not None else interpret
     return jax.shard_map(
@@ -303,6 +362,12 @@ def transformer_sharding_rules() -> Dict[str, P]:
         "wo": P("tp", None, None),
         "w_in": P(None, "tp"),
         "w_out": P("tp", None),
+        # MoE layers: experts sharded over tp (ep-over-tp), router
+        # replicated.  Needles are keystr substrings; the longer
+        # moe-qualified patterns beat the dense "w_in"/"w_out" ones.
+        "moe']['w_in": P("tp", None, None),
+        "moe']['w_out": P("tp", None, None),
+        "router": P(),
         "lm_head": P(None, "tp"),
         "norm": P(),
         "scale": P(),
@@ -342,6 +407,9 @@ def transformer_apply_pipelined(
     sp_attention = config.attention in ("ring", "ulysses")
     if sp_attention:
         _validate_sp_entry(config.attention, config, mesh, seq_axis)
+    elif config.moe_every is not None:
+        raise ValueError(
+            "MoE layers are not supported on the pipelined path yet")
     n_stages = mesh.shape[pp_axis]
     if config.n_layers % n_stages != 0:
         raise ValueError(
@@ -389,7 +457,8 @@ def transformer_apply_pipelined(
                     interpret=interpret)
 
             def body(x, layer):
-                return _layer_forward(layer, x, attn, dtype, pos), None
+                x, _ = _layer_forward(layer, x, attn, dtype, pos)
+                return x, None
 
             x, _ = jax.lax.scan(body, x, stage_layers)
             return x
@@ -404,8 +473,9 @@ def transformer_apply_pipelined(
 
         def stage_fn(stage_layers, x):
             def body(x, layer):
-                return _layer_forward(layer, x, attention_fn, dtype,
-                                      positions), None
+                x, _ = _layer_forward(layer, x, attention_fn, dtype,
+                                      positions)
+                return x, None
 
             x, _ = jax.lax.scan(body, x, stage_layers)
             return x

@@ -16,6 +16,7 @@ from kubeshare_tpu.models import (
     resnet_apply,
     resnet_init,
     transformer_apply,
+    transformer_apply_with_aux,
     transformer_init,
 )
 from kubeshare_tpu.models.transformer import (
@@ -1080,3 +1081,102 @@ class TestRemat:
         for a, b in zip(jax.tree.leaves(g_plain), jax.tree.leaves(g_remat)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-5, atol=1e-6)
+
+
+class TestMoEFlagship:
+    """MoE layers inside the flagship Transformer (config.moe_every)."""
+
+    def _config(self, **kw):
+        kw.setdefault("moe_every", 2)
+        kw.setdefault("moe_num_experts", 4)
+        kw.setdefault("moe_capacity_factor", 8.0)  # ample: no token drops
+        kw.setdefault("attention", "reference")
+        return TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+            max_seq_len=32, dtype=jnp.float32, **kw)
+
+    def test_init_places_moe_layers(self):
+        config = self._config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        kinds = ["moe" if "moe" in l else "mlp" for l in params["layers"]]
+        assert kinds == ["mlp", "moe", "mlp", "moe"]
+        assert params["layers"][1]["moe"]["w_in"].shape == (4, 32, 64)
+
+    def test_forward_and_aux(self):
+        config = self._config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+        logits = transformer_apply(params, tokens, config)
+        assert logits.shape == (2, 16, 64)
+        assert np.isfinite(np.asarray(logits)).all()
+        logits2, aux = transformer_apply_with_aux(params, tokens, config)
+        np.testing.assert_array_equal(np.asarray(logits), np.asarray(logits2))
+        assert float(aux) > 0.0  # two MoE layers contribute load-balance loss
+
+    def test_router_gets_gradients_through_aux(self):
+        config = self._config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, 64)
+
+        def loss(p):
+            logits, aux = transformer_apply_with_aux(p, tokens, config)
+            targets = jnp.zeros(tokens.shape, jnp.int32)
+            return cross_entropy_loss(logits, targets) + 0.01 * aux
+
+        grads = jax.grad(loss)(params)
+        g_router = np.asarray(grads["layers"][1]["moe"]["router"])
+        assert np.isfinite(g_router).all()
+        assert np.abs(g_router).sum() > 0
+
+    def test_decode_matches_full_forward(self):
+        from kubeshare_tpu.models.decoding import prefill
+
+        config = self._config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 10), 0, 64)
+        dense = transformer_apply(params, prompt, config)
+        _, last_logits = prefill(params, config, prompt)
+        np.testing.assert_allclose(
+            np.asarray(dense[:, -1]), np.asarray(last_logits),
+            rtol=2e-4, atol=2e-4)
+
+    def test_sampled_decode_runs(self):
+        from kubeshare_tpu.models.decoding import sample_decode
+
+        config = self._config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        toks = sample_decode(params, config, prompt, jax.random.PRNGKey(5),
+                             6, temperature=0.8, top_k=8)
+        assert toks.shape == (1, 6)
+
+    def test_sharding_rules_place_experts_on_tp(self):
+        from kubeshare_tpu.models.transformer import transformer_sharding_rules
+        from kubeshare_tpu.parallel.mesh import shard_params
+
+        config = self._config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        mesh = make_mesh(MeshSpec(dp=2, tp=2, sp=2))
+        placed = shard_params(params, transformer_sharding_rules(), mesh)
+        moe = placed["layers"][1]["moe"]
+        assert moe["w_in"].sharding.spec == P("tp", None, None)
+        assert moe["w_out"].sharding.spec == P("tp", None, None)
+        assert moe["router"].sharding.spec == P()
+        # tp-sharded forward still matches unsharded
+        tokens = jax.random.randint(jax.random.PRNGKey(6), (2, 8), 0, 64)
+        base = transformer_apply(params, tokens, config)
+        sharded = jax.jit(
+            lambda p, t: transformer_apply(p, t, config))(placed, tokens)
+        np.testing.assert_allclose(np.asarray(base), np.asarray(sharded),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_sp_paths_reject_moe(self):
+        from kubeshare_tpu.models.transformer import transformer_apply_ring
+
+        config = self._config(attention="ring")
+        params_cfg = self._config()
+        params = transformer_init(jax.random.PRNGKey(0), params_cfg)
+        mesh = make_mesh(MeshSpec(dp=2, tp=1, sp=4))
+        with pytest.raises(ValueError, match="MoE"):
+            transformer_apply_ring(params, jnp.zeros((2, 8), jnp.int32),
+                                   config, mesh)
