@@ -79,9 +79,11 @@ def _decode_one(params, config: TransformerConfig, cache: Dict, token: jax.Array
         x = x + jnp.einsum("bhsk,hkd->bsd", o, layer["attn"]["wo"].astype(dtype))
         y = _rms_norm(x, layer["norm2"]["scale"])
         if "moe" in layer:
-            # single-token MoE step: routing is per-token (top-1 argmax),
-            # so incremental decode matches the full forward as long as
-            # capacity never drops tokens (config.moe_capacity_factor)
+            # single-token MoE step: routing is per-token (top-1 argmax).
+            # The step only sees batch-many tokens, so a factor-derived
+            # capacity would collapse to ~1 and silently drop rows that
+            # share an expert; capacity=batch guarantees no drops and the
+            # buffer stays tiny.
             from ..ops.moe import MoEConfig, moe_apply
 
             e, d_m, f = layer["moe"]["w_in"].shape
@@ -89,6 +91,7 @@ def _decode_one(params, config: TransformerConfig, cache: Dict, token: jax.Array
                 layer["moe"], y,
                 MoEConfig(d_model=d_m, d_ff=f, num_experts=e,
                           capacity_factor=config.moe_capacity_factor),
+                capacity=y.shape[0] * y.shape[1],
             )
             x = x + out.astype(dtype)
         else:
@@ -166,7 +169,10 @@ def _filter_logits(
     if top_k is not None:
         if top_k < 1:
             raise ValueError(f"top_k must be >= 1, got {top_k}")
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        # clamp so top_k >= vocab intentionally keeps everything (rather
+        # than leaning on JAX's silent out-of-bounds index clamping)
+        k = min(top_k, logits.shape[-1])
+        kth = jnp.sort(logits, axis=-1)[:, -k][:, None]
         logits = jnp.where(logits >= kth, logits, -jnp.inf)
     if top_p is not None:
         if not 0.0 < top_p <= 1.0:

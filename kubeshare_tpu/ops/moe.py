@@ -10,8 +10,9 @@ token-dropping MoE implementations.
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,17 +39,29 @@ def moe_init(rng: jax.Array, config: MoEConfig) -> Dict:
     }
 
 
-def moe_apply(params: Dict, x: jax.Array, config: MoEConfig) -> Tuple[jax.Array, jax.Array]:
+def moe_apply(
+    params: Dict,
+    x: jax.Array,
+    config: MoEConfig,
+    capacity: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
     """x: [batch, seq, d_model] -> (output, aux_loss).
 
     Top-1 routing with capacity-bounded dense dispatch; aux_loss is the
     standard load-balancing term (mean_prob * mean_assignment * E).
+
+    ``capacity`` overrides the derived per-expert buffer size; pass
+    ``capacity=n_tokens`` to guarantee no token is ever dropped (the
+    incremental-decode path relies on this).
     """
     b, s, d = x.shape
     e = config.num_experts
     tokens = x.reshape(b * s, d)
     n = tokens.shape[0]
-    capacity = max(1, int(config.capacity_factor * n / e))
+    if capacity is None:
+        capacity = max(1, math.ceil(config.capacity_factor * n / e))
+    elif capacity < 1:
+        raise ValueError(f"capacity must be >= 1, got {capacity}")
 
     logits = tokens @ params["router"]  # [n, e]
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)

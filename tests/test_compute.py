@@ -759,6 +759,9 @@ class TestSampledDecoding:
         out = np.asarray(_filter_logits(logits, top_k=2, top_p=None))
         assert np.isfinite(out[0, 1]) and np.isfinite(out[0, 2])
         assert np.isneginf(out[0, 0]) and np.isneginf(out[0, 3])
+        # top_k >= vocab keeps everything (explicit clamp, ADVICE r2)
+        out = np.asarray(_filter_logits(logits, top_k=100, top_p=None))
+        assert np.isfinite(out).all()
 
     def test_filter_logits_top_p(self):
         from kubeshare_tpu.models.decoding import _filter_logits
@@ -1180,3 +1183,57 @@ class TestMoEFlagship:
         with pytest.raises(ValueError, match="MoE"):
             transformer_apply_ring(params, jnp.zeros((2, 8), jnp.int32),
                                    config, mesh)
+
+    def test_decode_batch_independent_at_default_capacity(self):
+        """Batched incremental decode must equal per-row decode even at the
+        default capacity_factor (1.25): the decode path pins capacity to the
+        per-step token count, so expert collisions between batch rows can
+        never drop a row's token (ADVICE r2, decoding.py)."""
+        from kubeshare_tpu.models.decoding import prefill
+
+        config = self._config(moe_capacity_factor=1.25)
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        # batch 4 over 4 experts: some step almost surely routes two rows
+        # to the same expert, which the old factor-derived capacity dropped
+        prompt = jax.random.randint(jax.random.PRNGKey(7), (4, 8), 0, 64)
+        _, batched = prefill(params, config, prompt)
+        for row in range(prompt.shape[0]):
+            _, single = prefill(params, config, prompt[row:row + 1])
+            np.testing.assert_allclose(
+                np.asarray(batched[row:row + 1]), np.asarray(single),
+                rtol=2e-4, atol=2e-4)
+
+
+class TestMoECapacity:
+    def test_capacity_rounds_up(self):
+        """capacity = ceil(cf*n/e), not floor (ADVICE r2, moe.py): route all
+        5 tokens to expert 0 with cf=1.0, e=4 -> capacity must be 2, so
+        exactly 2 token rows survive (floor kept only 1)."""
+        from kubeshare_tpu.ops.moe import MoEConfig, moe_apply, moe_init
+
+        config = MoEConfig(d_model=8, d_ff=16, num_experts=4,
+                           capacity_factor=1.0)
+        params = dict(moe_init(jax.random.PRNGKey(0), config))
+        router = np.zeros((8, 4), np.float32)
+        router[:, 0] = 100.0  # positive-sum tokens all argmax to expert 0
+        params["router"] = jnp.asarray(router)
+        x = 0.1 + jnp.abs(
+            jax.random.normal(jax.random.PRNGKey(1), (1, 5, 8), jnp.float32))
+        out, _ = moe_apply(params, x, config)
+        kept_rows = np.abs(np.asarray(out[0])).sum(axis=-1) > 0
+        assert kept_rows.sum() == 2
+
+    def test_capacity_override_keeps_all_tokens(self):
+        from kubeshare_tpu.ops.moe import MoEConfig, moe_apply, moe_init
+
+        config = MoEConfig(d_model=8, d_ff=16, num_experts=4,
+                           capacity_factor=1.0)
+        params = moe_init(jax.random.PRNGKey(0), config)
+        x = jax.random.normal(jax.random.PRNGKey(2), (2, 6, 8), jnp.float32)
+        ample = moe_apply(params, x, config, capacity=12)[0]
+        huge_cf = moe_apply(
+            params, x,
+            MoEConfig(d_model=8, d_ff=16, num_experts=4,
+                      capacity_factor=100.0))[0]
+        np.testing.assert_allclose(np.asarray(ample), np.asarray(huge_cf),
+                                   rtol=1e-6, atol=1e-6)
