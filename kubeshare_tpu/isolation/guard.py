@@ -11,12 +11,16 @@ The TPU-native enforcement points (SURVEY §7.2):
   1. placement admission — the scheduler only co-locates pods whose HBM
      requests fit the chip (the hard guarantee, like k8s memory requests);
   2. broker accounting — the PJRT interposer charges every host->device
-     upload against the pod's cap via the MEM protocol (credited on buffer
-     destroy); over-cap pods are flagged to the operator (soft deny);
+     upload AND every executable output buffer against the pod's cap via
+     the MEM protocol (credited on buffer destroy); over-cap allocations
+     are hard-denied by default (fabricated RESOURCE_EXHAUSTED), or
+     log-only with TPUSHARE_MEM_ENFORCE=soft;
   3. client flags — ``apply_hbm_cap`` translates the scheduler-injected
-     TPUSHARE_MEM_FRACTION into XLA client allocator flags where the
-     backend honors them (GPU yes; TPU runtimes currently ignore the
-     fraction knob, which is why levels 1-2 carry the enforcement).
+     TPUSHARE_MEM_FRACTION into XLA client allocator flags for in-process
+     workloads; the LD_PRELOAD shim's constructor does the same for
+     preload-only pods and additionally injects memory_fraction /
+     preallocate create options at PJRT_Client_Create (fail-open where
+     the plugin rejects them).
 """
 
 from __future__ import annotations

@@ -132,8 +132,11 @@ struct Gang {
 };
 
 Gang* g_gang() {
-  static Gang g;
-  return &g;
+  // intentionally leaked: runtime completion-callback threads may call
+  // tpushare_release after main returns; destroying the gang under them
+  // is a use-after-free at process exit
+  static Gang* g = new Gang;
+  return g;
 }
 
 std::vector<EndpointPtr> Snapshot() {

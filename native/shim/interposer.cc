@@ -23,9 +23,22 @@
 //    fabricated RESOURCE_EXHAUSTED PJRT_Error without reaching the real
 //    plugin (Gemini rejected over-cap cuMemAlloc the same way).  Set
 //    TPUSHARE_MEM_ENFORCE=soft for log-and-account-only.
+//  * Every allocation path is covered, not just uploads (Gemini capped
+//    every CUDA alloc; SURVEY §7.4 flags client-init preallocation as the
+//    TPU-specific hard part):
+//      - client-init preallocation: a library constructor exports the
+//        XLA allocator-fraction env from TPUSHARE_MEM_FRACTION before the
+//        runtime starts, and PJRT_Client_Create injects memory_fraction /
+//        preallocate=false create options (retried without them when the
+//        plugin rejects unknown options — fail open, never fail the client);
+//      - executable outputs: after each Execute the output buffers are
+//        charged on first sighting (size via Buffer_OnDeviceSizeInBytes).
+//        An output the broker denies goes on a local OVERFLOW ledger: the
+//        pod is now over cap, so in hard mode every subsequent upload AND
+//        execute is denied until enough buffers are destroyed.
 //  * Accounting is symmetric: only buffers this shim charged are credited
-//    back on destroy, by exactly the charged amount — executable outputs
-//    and device-to-device copies never drift the ledger.
+//    back on destroy, by exactly the charged amount — the ledger can
+//    never drift toward zero from buffers it never saw.
 //
 // The PJRT_Api table is copied and entry pointers swapped; a struct_size
 // check skips hooking when the runtime's API is older than the header we
@@ -69,9 +82,42 @@ void (*g_real_error_message)(PJRT_Error_Message_Args*) = nullptr;
 PJRT_Error* (*g_real_error_get_code)(PJRT_Error_GetCode_Args*) = nullptr;
 PJRT_Error* (*g_real_event_on_ready)(PJRT_Event_OnReady_Args*) = nullptr;
 PJRT_Error* (*g_real_event_destroy)(PJRT_Event_Destroy_Args*) = nullptr;
+PJRT_Error* (*g_real_client_create)(PJRT_Client_Create_Args*) = nullptr;
+PJRT_Error* (*g_real_buffer_size)(PJRT_Buffer_OnDeviceSizeInBytes_Args*) =
+    nullptr;
+PJRT_Error* (*g_real_get_executable)(PJRT_LoadedExecutable_GetExecutable_Args*) =
+    nullptr;
+PJRT_Error* (*g_real_executable_num_outputs)(PJRT_Executable_NumOutputs_Args*) =
+    nullptr;
+PJRT_Error* (*g_real_executable_destroy)(PJRT_Executable_Destroy_Args*) =
+    nullptr;
+PJRT_Error* (*g_real_loaded_destroy)(PJRT_LoadedExecutable_Destroy_Args*) =
+    nullptr;
 
 bool g_gated = false;
 bool g_mem_soft = false;
+
+void DestroyRealError(PJRT_Error* error) {
+  if (error == nullptr || g_real_error_destroy == nullptr) return;
+  PJRT_Error_Destroy_Args args;
+  std::memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  args.error = error;
+  g_real_error_destroy(&args);
+}
+
+// TPUSHARE_MEM_FRACTION parsed once; <= 0 when absent/invalid.
+double MemFraction() {
+  static double fraction = [] {
+    const char* raw = std::getenv("TPUSHARE_MEM_FRACTION");
+    if (raw == nullptr || *raw == '\0') return -1.0;
+    char* end = nullptr;
+    double value = std::strtod(raw, &end);
+    if (end == raw || value <= 0.0 || value > 1.0) return -1.0;
+    return value;
+  }();
+  return fraction;
+}
 
 // ---------------------------------------------------------------------------
 // Fabricated errors.  PJRT_Error is plugin-opaque, so we mint our own
@@ -86,8 +132,8 @@ struct ShimError {
 
 std::mutex g_error_mu;
 std::set<const void*>& ShimErrors() {
-  static std::set<const void*> errors;
-  return errors;
+  static std::set<const void*>* errors = new std::set<const void*>;
+  return *errors;  // leaked: see RetiredEvents
 }
 
 PJRT_Error* MakeShimError(PJRT_Error_Code code, std::string message) {
@@ -141,8 +187,23 @@ PJRT_Error* HookedErrorGetCode(PJRT_Error_GetCode_Args* args) {
 
 std::mutex g_mem_mu;
 std::unordered_map<const void*, long long>& ChargedBuffers() {
-  static std::unordered_map<const void*, long long> charged;
-  return charged;
+  static auto* charged = new std::unordered_map<const void*, long long>;
+  return *charged;  // leaked: see RetiredEvents
+}
+
+// Output buffers the broker DENIED: the pod is over cap by this much.
+// The broker ledger stays at <= cap; the shim carries the excess locally
+// and (in hard mode) refuses further uploads/executes until destroys
+// bring the overflow back to zero.
+long long g_overflow_bytes = 0;  // guarded by g_mem_mu
+std::unordered_map<const void*, long long>& OverflowBuffers() {
+  static auto* overflow = new std::unordered_map<const void*, long long>;
+  return *overflow;  // leaked: see RetiredEvents
+}
+
+long long OverflowBytes() {
+  std::lock_guard<std::mutex> lock(g_mem_mu);
+  return g_overflow_bytes;
 }
 
 long long ElementBytes(PJRT_Buffer_Type type) {
@@ -173,6 +234,18 @@ PJRT_Error* HookedBufferFromHost(PJRT_Client_BufferFromHostBuffer_Args* args) {
   long long elements = 1;
   for (size_t i = 0; i < args->num_dims; i++) elements *= args->dims[i];
   long long bytes = elements * ElementBytes(args->type);
+  long long overflow = OverflowBytes();
+  if (overflow > 0 && !g_mem_soft) {
+    // executable outputs already hold the pod over its cap: no new
+    // uploads until destroys clear the overflow
+    char msg[200];
+    std::snprintf(msg, sizeof(msg),
+                  "tpushare: HBM cap exceeded: pod is %lld bytes over its "
+                  "gpu_mem cap (executable outputs); %lld-byte upload denied",
+                  overflow, bytes);
+    std::fprintf(stderr, "tpushim: %s\n", msg);
+    return MakeShimError(PJRT_Error_Code_RESOURCE_EXHAUSTED, msg);
+  }
   int rc = tpushare_mem_request(bytes);
   bool charged = rc > 0;
   if (rc == 0) {  // broker said DENY; rc<0 (broker gone) fails open
@@ -209,12 +282,153 @@ PJRT_Error* HookedBufferDestroy(PJRT_Buffer_Destroy_Args* args) {
         credit = it->second;
         ChargedBuffers().erase(it);
       }
+      auto over = OverflowBuffers().find(args->buffer);
+      if (over != OverflowBuffers().end()) {
+        // broker never recorded this charge: clear it locally, no credit
+        g_overflow_bytes -= over->second;
+        if (g_overflow_bytes < 0) g_overflow_bytes = 0;
+        OverflowBuffers().erase(over);
+      }
     }
-    // credit only what we charged: buffers we never saw (executable
-    // outputs, device-to-device copies) must not drift usage toward zero
+    // credit only what we charged: buffers we never saw (device-to-device
+    // copies, a second plugin's buffers) must not drift usage toward zero
     if (credit > 0) tpushare_mem_request(-credit);
   }
   return g_real_buffer_destroy(args);
+}
+
+// -----------------------------------------------------------------------
+// Executable output accounting: outputs allocate HBM without passing any
+// host->device hook, so Execute charges them on first sighting.  The
+// per-LoadedExecutable output count comes from GetExecutable →
+// NumOutputs, cached after the first lookup.
+// -----------------------------------------------------------------------
+
+std::unordered_map<const void*, size_t>& NumOutputsCache() {
+  static auto* cache =
+      new std::unordered_map<const void*, size_t>;  // guarded by g_mem_mu
+  return *cache;  // leaked: see RetiredEvents
+}
+
+bool LookupNumOutputs(PJRT_LoadedExecutable* loaded, size_t* num_outputs) {
+  if (loaded == nullptr || g_real_get_executable == nullptr ||
+      g_real_executable_num_outputs == nullptr) {
+    return false;
+  }
+  {
+    std::lock_guard<std::mutex> lock(g_mem_mu);
+    auto it = NumOutputsCache().find(loaded);
+    if (it != NumOutputsCache().end()) {
+      *num_outputs = it->second;
+      return true;
+    }
+  }
+  PJRT_LoadedExecutable_GetExecutable_Args get_args;
+  std::memset(&get_args, 0, sizeof(get_args));
+  get_args.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+  get_args.loaded_executable = loaded;
+  if (PJRT_Error* err = g_real_get_executable(&get_args)) {
+    DestroyRealError(err);
+    return false;
+  }
+  PJRT_Executable_NumOutputs_Args num_args;
+  std::memset(&num_args, 0, sizeof(num_args));
+  num_args.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+  num_args.executable = get_args.executable;
+  PJRT_Error* err = g_real_executable_num_outputs(&num_args);
+  bool ok = err == nullptr;
+  if (err != nullptr) DestroyRealError(err);
+  if (g_real_executable_destroy != nullptr && get_args.executable != nullptr) {
+    PJRT_Executable_Destroy_Args destroy_args;
+    std::memset(&destroy_args, 0, sizeof(destroy_args));
+    destroy_args.struct_size = PJRT_Executable_Destroy_Args_STRUCT_SIZE;
+    destroy_args.executable = get_args.executable;
+    if (PJRT_Error* destroy_err = g_real_executable_destroy(&destroy_args)) {
+      DestroyRealError(destroy_err);
+    }
+  }
+  if (!ok) return false;
+  *num_outputs = num_args.num_outputs;
+  std::lock_guard<std::mutex> lock(g_mem_mu);
+  NumOutputsCache()[loaded] = num_args.num_outputs;
+  return true;
+}
+
+long long BufferDeviceBytes(PJRT_Buffer* buffer) {
+  if (buffer == nullptr || g_real_buffer_size == nullptr) return -1;
+  PJRT_Buffer_OnDeviceSizeInBytes_Args args;
+  std::memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Buffer_OnDeviceSizeInBytes_Args_STRUCT_SIZE;
+  args.buffer = buffer;
+  if (PJRT_Error* err = g_real_buffer_size(&args)) {
+    DestroyRealError(err);
+    return -1;
+  }
+  return static_cast<long long>(args.on_device_size_in_bytes);
+}
+
+// Charge one output buffer against the cap (first sighting only).  A
+// broker DENY moves the bytes onto the local overflow ledger — the
+// allocation already happened on-device, so the accounting must record
+// it even though it exceeds the cap; subsequent uploads/executes are
+// what get denied.
+void ChargeOutputBuffer(PJRT_Buffer* buffer) {
+  if (buffer == nullptr) return;
+  {
+    // dedup before the plugin size query: re-sighted (donated-alias)
+    // buffers on the per-step hot path cost no plugin round trip
+    std::lock_guard<std::mutex> lock(g_mem_mu);
+    if (ChargedBuffers().count(buffer) != 0 ||
+        OverflowBuffers().count(buffer) != 0) {
+      return;
+    }
+  }
+  long long bytes = BufferDeviceBytes(buffer);
+  if (bytes <= 0) return;
+  int rc = tpushare_mem_request(bytes);
+  std::lock_guard<std::mutex> lock(g_mem_mu);
+  if (rc > 0) {
+    ChargedBuffers()[buffer] += bytes;
+  } else if (rc == 0) {
+    OverflowBuffers()[buffer] += bytes;
+    g_overflow_bytes += bytes;
+    std::fprintf(stderr,
+                 "tpushim: HBM cap exceeded: %lld-byte executable output "
+                 "puts pod %lld bytes over its gpu_mem cap%s\n",
+                 bytes, g_overflow_bytes,
+                 g_mem_soft ? " (soft mode)" : "; further uploads/executes "
+                                               "will be denied");
+  }  // rc < 0: broker gone, fail open
+}
+
+// Invalidate the cached output count when a loaded executable dies: its
+// address can be reused by a later executable with a different count, and
+// a stale count would walk past the caller's output_lists.
+PJRT_Error* HookedLoadedExecutableDestroy(
+    PJRT_LoadedExecutable_Destroy_Args* args) {
+  if (args->executable != nullptr) {
+    std::lock_guard<std::mutex> lock(g_mem_mu);
+    NumOutputsCache().erase(args->executable);
+  }
+  return g_real_loaded_destroy(args);
+}
+
+void ChargeExecuteOutputs(PJRT_LoadedExecutable_Execute_Args* args) {
+  // same old-struct guard the events path applies: a caller compiled
+  // against an older header may end before output_lists
+  if (args->struct_size < PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE) {
+    return;
+  }
+  if (args->output_lists == nullptr) return;
+  size_t num_outputs = 0;
+  if (!LookupNumOutputs(args->executable, &num_outputs)) return;
+  for (size_t d = 0; d < args->num_devices; d++) {
+    PJRT_Buffer** device_outputs = args->output_lists[d];
+    if (device_outputs == nullptr) continue;
+    for (size_t o = 0; o < num_outputs; o++) {
+      ChargeOutputBuffer(device_outputs[o]);
+    }
+  }
 }
 
 // ---------------------------------------------------------------------------
@@ -234,8 +448,11 @@ double g_last_complete_ms = 0.0;  // completion-to-completion charging anchor
 // Events we own whose callbacks have fired; destroyed on the next Execute
 // (never from inside the plugin's callback thread).
 std::vector<PJRT_Event*>& RetiredEvents() {
-  static std::vector<PJRT_Event*> retired;
-  return retired;
+  // intentionally leaked (like every container the runtime's completion
+  // callback thread can touch): OnExecuteComplete may fire after main
+  // returns, and a destroyed static here is a use-after-free at exit
+  static auto* retired = new std::vector<PJRT_Event*>;
+  return *retired;
 }
 
 void DrainRetiredEventsLocked() {
@@ -249,14 +466,7 @@ void DrainRetiredEventsLocked() {
     std::memset(&destroy_args, 0, sizeof(destroy_args));
     destroy_args.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
     destroy_args.event = event;
-    PJRT_Error* err = g_real_event_destroy(&destroy_args);
-    if (err != nullptr && g_real_error_destroy != nullptr) {
-      PJRT_Error_Destroy_Args err_args;
-      std::memset(&err_args, 0, sizeof(err_args));
-      err_args.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-      err_args.error = err;
-      g_real_error_destroy(&err_args);
-    }
+    DestroyRealError(g_real_event_destroy(&destroy_args));
   }
 }
 
@@ -282,13 +492,7 @@ struct ExecCharge {
 
 void OnExecuteComplete(PJRT_Error* error, void* user_arg) {
   auto* charge = static_cast<ExecCharge*>(user_arg);
-  if (error != nullptr && g_real_error_destroy != nullptr) {
-    PJRT_Error_Destroy_Args err_args;
-    std::memset(&err_args, 0, sizeof(err_args));
-    err_args.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-    err_args.error = error;
-    g_real_error_destroy(&err_args);
-  }
+  DestroyRealError(error);
   if (charge->primary) ChargeCompletion(charge->start_ms, NowMs());
   if (charge->owned) {
     std::lock_guard<std::mutex> lock(g_charge_mu);
@@ -299,6 +503,18 @@ void OnExecuteComplete(PJRT_Error* error, void* user_arg) {
 
 PJRT_Error* HookedExecute(PJRT_LoadedExecutable_Execute_Args* args) {
   if (!g_gated) return g_real_execute(args);
+  long long overflow = OverflowBytes();
+  if (overflow > 0 && !g_mem_soft) {
+    // over cap via executable outputs: executing would allocate more
+    // output HBM, so refuse until destroys clear the overflow
+    char msg[200];
+    std::snprintf(msg, sizeof(msg),
+                  "tpushare: HBM cap exceeded: pod is %lld bytes over its "
+                  "gpu_mem cap (executable outputs); execute denied",
+                  overflow);
+    std::fprintf(stderr, "tpushim: %s\n", msg);
+    return MakeShimError(PJRT_Error_Code_RESOURCE_EXHAUSTED, msg);
+  }
   double estimate;
   {
     std::lock_guard<std::mutex> lock(g_charge_mu);
@@ -323,6 +539,10 @@ PJRT_Error* HookedExecute(PJRT_LoadedExecutable_Execute_Args* args) {
   double start = NowMs();
   PJRT_Error* err = g_real_execute(args);
   double dispatch_end = NowMs();
+
+  // account the output buffers this execution allocated (charge on first
+  // sighting; credited when HookedBufferDestroy sees them)
+  if (err == nullptr) ChargeExecuteOutputs(args);
 
   if (err != nullptr && own) {
     // per spec the plugin does not populate events on error, but a plugin
@@ -350,13 +570,7 @@ PJRT_Error* HookedExecute(PJRT_LoadedExecutable_Execute_Args* args) {
       ready_args.user_arg = charge;
       PJRT_Error* ready_err = g_real_event_on_ready(&ready_args);
       if (ready_err != nullptr) {
-        if (g_real_error_destroy != nullptr) {
-          PJRT_Error_Destroy_Args err_args;
-          std::memset(&err_args, 0, sizeof(err_args));
-          err_args.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-          err_args.error = ready_err;
-          g_real_error_destroy(&err_args);
-        }
+        DestroyRealError(ready_err);
         delete charge;
         if (own) {
           std::lock_guard<std::mutex> lock(g_charge_mu);
@@ -380,6 +594,77 @@ PJRT_Error* HookedExecute(PJRT_LoadedExecutable_Execute_Args* args) {
     tpushare_release(elapsed);
   }
   return err;
+}
+
+// ---------------------------------------------------------------------------
+// Client create: client-init preallocation is the one allocation the
+// per-buffer hooks can never see (SURVEY §7.4) — the plugin may grab its
+// whole HBM share inside PJRT_Client_Create.  Inject allocator-cap create
+// options derived from TPUSHARE_MEM_FRACTION; if the plugin rejects the
+// (platform-specific) options, retry bare — enforcement falls back to the
+// upload/output ledger rather than failing the client.
+// ---------------------------------------------------------------------------
+
+PJRT_Error* HookedClientCreate(PJRT_Client_Create_Args* args) {
+  double fraction = MemFraction();
+  if (fraction <= 0.0) return g_real_client_create(args);
+
+  std::vector<PJRT_NamedValue> options(
+      args->create_options, args->create_options + args->num_options);
+  bool has_fraction = false, has_preallocate = false;
+  for (const PJRT_NamedValue& option : options) {
+    std::string name(option.name, option.name_size);
+    if (name == "memory_fraction") has_fraction = true;
+    if (name == "preallocate") has_preallocate = true;
+  }
+  if (has_fraction && has_preallocate) return g_real_client_create(args);
+
+  if (!has_fraction) {
+    PJRT_NamedValue fraction_option;
+    std::memset(&fraction_option, 0, sizeof(fraction_option));
+    fraction_option.struct_size = PJRT_NamedValue_STRUCT_SIZE;
+    fraction_option.name = "memory_fraction";
+    fraction_option.name_size = std::strlen("memory_fraction");
+    fraction_option.type = PJRT_NamedValue_kFloat;
+    fraction_option.float_value = static_cast<float>(fraction);
+    fraction_option.value_size = 1;
+    options.push_back(fraction_option);
+  }
+  if (!has_preallocate) {
+    // preallocation off: co-tenants must be able to start in any order
+    PJRT_NamedValue preallocate_option;
+    std::memset(&preallocate_option, 0, sizeof(preallocate_option));
+    preallocate_option.struct_size = PJRT_NamedValue_STRUCT_SIZE;
+    preallocate_option.name = "preallocate";
+    preallocate_option.name_size = std::strlen("preallocate");
+    preallocate_option.type = PJRT_NamedValue_kBool;
+    preallocate_option.bool_value = false;
+    preallocate_option.value_size = 1;
+    options.push_back(preallocate_option);
+  }
+
+  const PJRT_NamedValue* original_options = args->create_options;
+  size_t original_num = args->num_options;
+  args->create_options = options.data();
+  args->num_options = options.size();
+  PJRT_Error* err = g_real_client_create(args);
+  args->create_options = original_options;
+  args->num_options = original_num;
+  if (err == nullptr) {
+    std::fprintf(stderr,
+                 "tpushim: client created with memory_fraction=%.4f "
+                 "preallocate=false\n", fraction);
+    return nullptr;
+  }
+  // plugin rejected the injected options (or the create failed for any
+  // reason): retry exactly as the caller asked, so the shim never turns a
+  // working client into a broken one
+  DestroyRealError(err);
+  std::fprintf(stderr,
+               "tpushim: plugin rejected allocator-cap create options, "
+               "retrying without them (cap enforced by upload/output "
+               "accounting only)\n");
+  return g_real_client_create(args);
 }
 
 // ---------------------------------------------------------------------------
@@ -417,11 +702,23 @@ const PJRT_Api* WrapApi(const PJRT_Api* real) {
   g_real_error_get_code = wrapped.PJRT_Error_GetCode;
   g_real_event_on_ready = wrapped.PJRT_Event_OnReady;
   g_real_event_destroy = wrapped.PJRT_Event_Destroy;
+  g_real_client_create = wrapped.PJRT_Client_Create;
+  g_real_buffer_size = wrapped.PJRT_Buffer_OnDeviceSizeInBytes;
+  g_real_get_executable = wrapped.PJRT_LoadedExecutable_GetExecutable;
+  g_real_executable_num_outputs = wrapped.PJRT_Executable_NumOutputs;
+  g_real_executable_destroy = wrapped.PJRT_Executable_Destroy;
+  g_real_loaded_destroy = wrapped.PJRT_LoadedExecutable_Destroy;
   if (g_real_buffer_from_host != nullptr) {
     wrapped.PJRT_Client_BufferFromHostBuffer = HookedBufferFromHost;
   }
   if (g_real_buffer_destroy != nullptr) {
     wrapped.PJRT_Buffer_Destroy = HookedBufferDestroy;
+  }
+  if (g_real_client_create != nullptr) {
+    wrapped.PJRT_Client_Create = HookedClientCreate;
+  }
+  if (g_real_loaded_destroy != nullptr) {
+    wrapped.PJRT_LoadedExecutable_Destroy = HookedLoadedExecutableDestroy;
   }
   // fabricated-error service entries (pass-through for real errors)
   wrapped.PJRT_Error_Destroy = HookedErrorDestroy;
@@ -441,6 +738,21 @@ GetPjrtApiFn RealGetPjrtApi() {
   static GetPjrtApiFn real = reinterpret_cast<GetPjrtApiFn>(
       dlsym(RTLD_NEXT, "GetPjrtApi"));
   return real;
+}
+
+// Runs when the shim is LD_PRELOADed, before the interpreter (and any
+// JAX/XLA client) starts: translate TPUSHARE_MEM_FRACTION into the XLA
+// allocator env the way kubeshare_tpu.isolation.guard.apply_hbm_cap does
+// in-process, so a preload-only pod (no guard import) still gets its
+// client allocator capped at create time.  setenv(no-overwrite) keeps any
+// operator-set value authoritative.
+__attribute__((constructor)) void ExportAllocatorEnv() {
+  double fraction = MemFraction();
+  if (fraction <= 0.0) return;
+  char value[32];
+  std::snprintf(value, sizeof(value), "%.4f", fraction);
+  setenv("XLA_PYTHON_CLIENT_MEM_FRACTION", value, /*overwrite=*/0);
+  setenv("XLA_PYTHON_CLIENT_PREALLOCATE", "false", /*overwrite=*/0);
 }
 
 }  // namespace

@@ -7,6 +7,15 @@
 // after dispatch on a background thread — modelling the async-dispatch gap
 // the interposer's completion-time charging must measure (dispatch returns
 // immediately; the device is busy for n ms).
+//
+// FAKE_NUM_OUTPUTS=<k> sets Executable_NumOutputs and how many output
+// buffers Execute fills per device when the caller passes output_lists;
+// FAKE_OUTPUT_BYTES=<b> sets Buffer_OnDeviceSizeInBytes (default 4096) —
+// together they model executable output allocations the interposer must
+// charge.  FAKE_REJECT_CREATE_OPTIONS=1 makes Client_Create fail when any
+// create option is present (a plugin that rejects unknown options, for the
+// interposer's fail-open retry path); the last options seen are recorded
+// for fake_client_create_options().
 
 #include <atomic>
 #include <chrono>
@@ -39,6 +48,25 @@ int DeviceMs() {
   }();
   return ms;
 }
+
+int NumOutputs() {
+  static int n = [] {
+    const char* env = std::getenv("FAKE_NUM_OUTPUTS");
+    return env != nullptr ? std::atoi(env) : 0;
+  }();
+  return n;
+}
+
+long long OutputBytes() {
+  static long long bytes = [] {
+    const char* env = std::getenv("FAKE_OUTPUT_BYTES");
+    return env != nullptr ? std::atoll(env) : 4096LL;
+  }();
+  return bytes;
+}
+
+std::mutex g_create_mu;
+std::string g_create_options_seen;  // "name=value;..." of the last Create
 
 // ---------------------------------------------------------------------------
 // Errors: the plugin's own opaque PJRT_Error representation.
@@ -191,6 +219,70 @@ PJRT_Error* FakeExecute(PJRT_LoadedExecutable_Execute_Args* args) {
       Device().Push(event);
     }
   }
+  // fill caller-provided output slots with fresh buffer handles, the way a
+  // real plugin materializes per-device executable outputs
+  if (args->output_lists != nullptr) {
+    for (size_t d = 0; d < args->num_devices; d++) {
+      PJRT_Buffer** outputs = args->output_lists[d];
+      if (outputs == nullptr) continue;
+      for (int o = 0; o < NumOutputs(); o++) {
+        outputs[o] = reinterpret_cast<PJRT_Buffer*>(g_next_handle.fetch_add(16));
+      }
+    }
+  }
+  return nullptr;
+}
+
+PJRT_Error* FakeGetExecutable(PJRT_LoadedExecutable_GetExecutable_Args* args) {
+  args->executable =
+      reinterpret_cast<PJRT_Executable*>(g_next_handle.fetch_add(16));
+  return nullptr;
+}
+
+PJRT_Error* FakeNumOutputs(PJRT_Executable_NumOutputs_Args* args) {
+  args->num_outputs = static_cast<size_t>(NumOutputs());
+  return nullptr;
+}
+
+PJRT_Error* FakeExecutableDestroy(PJRT_Executable_Destroy_Args*) {
+  return nullptr;
+}
+
+PJRT_Error* FakeClientCreate(PJRT_Client_Create_Args* args) {
+  std::string seen;
+  for (size_t i = 0; i < args->num_options; i++) {
+    const PJRT_NamedValue& option = args->create_options[i];
+    seen.append(option.name, option.name_size);
+    seen.push_back('=');
+    char value[64] = "?";
+    switch (option.type) {
+      case PJRT_NamedValue_kFloat:
+        std::snprintf(value, sizeof(value), "%.4f", option.float_value);
+        break;
+      case PJRT_NamedValue_kBool:
+        std::snprintf(value, sizeof(value), "%s",
+                      option.bool_value ? "true" : "false");
+        break;
+      case PJRT_NamedValue_kInt64:
+        std::snprintf(value, sizeof(value), "%lld",
+                      static_cast<long long>(option.int64_value));
+        break;
+      default:
+        break;
+    }
+    seen += value;
+    seen.push_back(';');
+  }
+  {
+    std::lock_guard<std::mutex> lock(g_create_mu);
+    g_create_options_seen = seen;
+  }
+  const char* reject = std::getenv("FAKE_REJECT_CREATE_OPTIONS");
+  if (reject != nullptr && *reject == '1' && args->num_options > 0) {
+    return reinterpret_cast<PJRT_Error*>(new FakeError{
+        "fake plugin: unknown create options", PJRT_Error_Code_INVALID_ARGUMENT});
+  }
+  args->client = reinterpret_cast<PJRT_Client*>(g_next_handle.fetch_add(16));
   return nullptr;
 }
 
@@ -206,7 +298,7 @@ PJRT_Error* FakeBufferDestroy(PJRT_Buffer_Destroy_Args*) {
 }
 
 PJRT_Error* FakeOnDeviceSize(PJRT_Buffer_OnDeviceSizeInBytes_Args* args) {
-  args->on_device_size_in_bytes = 4096;
+  args->on_device_size_in_bytes = static_cast<size_t>(OutputBytes());
   return nullptr;
 }
 
@@ -220,6 +312,13 @@ int fake_destroy_calls(void) { return g_destroy_calls.load(); }
 int fake_events_created(void) { return g_events_created.load(); }
 int fake_events_fired(void) { return g_events_fired.load(); }
 int fake_events_destroyed(void) { return g_events_destroyed.load(); }
+
+const char* fake_client_create_options(void) {
+  static std::string copy;
+  std::lock_guard<std::mutex> lock(g_create_mu);
+  copy = g_create_options_seen;
+  return copy.c_str();
+}
 
 const PJRT_Api* GetPjrtApi(void) {
   static PJRT_Api api;
@@ -240,6 +339,10 @@ const PJRT_Api* GetPjrtApi(void) {
     api.PJRT_Client_BufferFromHostBuffer = FakeBufferFromHost;
     api.PJRT_Buffer_Destroy = FakeBufferDestroy;
     api.PJRT_Buffer_OnDeviceSizeInBytes = FakeOnDeviceSize;
+    api.PJRT_Client_Create = FakeClientCreate;
+    api.PJRT_LoadedExecutable_GetExecutable = FakeGetExecutable;
+    api.PJRT_Executable_NumOutputs = FakeNumOutputs;
+    api.PJRT_Executable_Destroy = FakeExecutableDestroy;
     initialized = true;
   }
   return &api;

@@ -9,6 +9,14 @@
 //   --keep-buffer      skip the destroy after a successful upload
 //   --events           caller-owned completion events: request
 //                      device_complete_events, await + destroy them
+//   --outputs K        pass output_lists with K slots per execute (sets
+//                      FAKE_NUM_OUTPUTS for the fake plugin); prints
+//                      "execute_denied i=<i> code=<c>" for denied executes
+//   --destroy-outputs  destroy collected output buffers BEFORE the upload
+//                      attempt (frees the charged HBM first)
+//   --create-client    call PJRT_Client_Create with zero options first;
+//                      prints "client_ok options=<recorded>" or
+//                      "client_err"
 //   --sleep-ms S       sleep S ms before exit (lets async completion
 //                      callbacks deliver their RET to the tokend)
 
@@ -20,6 +28,7 @@
 #include <cstring>
 #include <string>
 #include <thread>
+#include <vector>
 
 #include "xla/pjrt/c/pjrt_c_api.h"
 
@@ -31,6 +40,9 @@ int main(int argc, char** argv) {
   long long upload_bytes = 4096;
   bool keep_buffer = false;
   bool caller_events = false;
+  bool destroy_outputs = false;
+  bool create_client = false;
+  int num_outputs = 0;
   int sleep_ms = 0;
   for (int i = 3; i < argc; i++) {
     std::string flag = argv[i];
@@ -40,6 +52,13 @@ int main(int argc, char** argv) {
       keep_buffer = true;
     } else if (flag == "--events") {
       caller_events = true;
+    } else if (flag == "--outputs" && i + 1 < argc) {
+      num_outputs = std::atoi(argv[++i]);
+      setenv("FAKE_NUM_OUTPUTS", argv[i], 1);  // keep plugin+driver in sync
+    } else if (flag == "--destroy-outputs") {
+      destroy_outputs = true;
+    } else if (flag == "--create-client") {
+      create_client = true;
     } else if (flag == "--sleep-ms" && i + 1 < argc) {
       sleep_ms = std::atoi(argv[++i]);
     }
@@ -62,16 +81,70 @@ int main(int argc, char** argv) {
     return 1;
   }
 
+  if (create_client) {
+    PJRT_Client_Create_Args create_args;
+    std::memset(&create_args, 0, sizeof(create_args));
+    create_args.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+    PJRT_Error* create_err = api->PJRT_Client_Create(&create_args);
+    auto recorded = reinterpret_cast<const char* (*)()>(
+        dlsym(handle, "fake_client_create_options"));
+    if (create_err == nullptr) {
+      std::printf("client_ok options=%s\n",
+                  recorded != nullptr ? recorded() : "?");
+    } else {
+      std::printf("client_err options=%s\n",
+                  recorded != nullptr ? recorded() : "?");
+      if (api->PJRT_Error_Destroy != nullptr) {
+        PJRT_Error_Destroy_Args destroy_args;
+        std::memset(&destroy_args, 0, sizeof(destroy_args));
+        destroy_args.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+        destroy_args.error = create_err;
+        api->PJRT_Error_Destroy(&destroy_args);
+      }
+    }
+  }
+
   int n = std::atoi(argv[2]);
   int events_ready = 0;
+  std::vector<PJRT_Buffer*> collected_outputs;
   for (int i = 0; i < n; i++) {
     PJRT_LoadedExecutable_Execute_Args args;
     std::memset(&args, 0, sizeof(args));
     args.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
     args.num_devices = 1;
+    // a fake loaded-executable handle so the interposer can look up the
+    // output count the way it would on a real runtime
+    args.executable = reinterpret_cast<PJRT_LoadedExecutable*>(0x10);
     PJRT_Event* events[1] = {nullptr};
     if (caller_events) args.device_complete_events = events;
-    api->PJRT_LoadedExecutable_Execute(&args);
+    std::vector<PJRT_Buffer*> output_slots(
+        num_outputs > 0 ? num_outputs : 0, nullptr);
+    PJRT_Buffer** output_list[1] = {output_slots.data()};
+    if (num_outputs > 0) args.output_lists = output_list;
+    PJRT_Error* exec_err = api->PJRT_LoadedExecutable_Execute(&args);
+    if (exec_err != nullptr) {
+      PJRT_Error_Code code = PJRT_Error_Code_UNKNOWN;
+      if (api->PJRT_Error_GetCode != nullptr) {
+        PJRT_Error_GetCode_Args code_args;
+        std::memset(&code_args, 0, sizeof(code_args));
+        code_args.struct_size = PJRT_Error_GetCode_Args_STRUCT_SIZE;
+        code_args.error = exec_err;
+        api->PJRT_Error_GetCode(&code_args);
+        code = code_args.code;
+      }
+      std::printf("execute_denied i=%d code=%d\n", i, static_cast<int>(code));
+      if (api->PJRT_Error_Destroy != nullptr) {
+        PJRT_Error_Destroy_Args destroy_args;
+        std::memset(&destroy_args, 0, sizeof(destroy_args));
+        destroy_args.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+        destroy_args.error = exec_err;
+        api->PJRT_Error_Destroy(&destroy_args);
+      }
+      continue;
+    }
+    for (PJRT_Buffer* buffer : output_slots) {
+      if (buffer != nullptr) collected_outputs.push_back(buffer);
+    }
     if (caller_events && events[0] != nullptr) {
       if (api->PJRT_Event_Await != nullptr) {
         PJRT_Event_Await_Args await_args;
@@ -91,6 +164,19 @@ int main(int argc, char** argv) {
     }
   }
   if (caller_events) std::printf("events_ready %d\n", events_ready);
+  if (num_outputs > 0) {
+    std::printf("outputs_collected %zu\n", collected_outputs.size());
+  }
+  if (destroy_outputs && api->PJRT_Buffer_Destroy != nullptr) {
+    for (PJRT_Buffer* buffer : collected_outputs) {
+      PJRT_Buffer_Destroy_Args destroy_args;
+      std::memset(&destroy_args, 0, sizeof(destroy_args));
+      destroy_args.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+      destroy_args.buffer = buffer;
+      api->PJRT_Buffer_Destroy(&destroy_args);
+    }
+    std::printf("outputs_destroyed %zu\n", collected_outputs.size());
+  }
 
   // one host->device upload of upload_bytes (f32), destroyed again unless
   // kept: exercises the HBM accounting + hard-denial hooks

@@ -456,6 +456,107 @@ class TestInterposer:
         assert out.returncode == 0, out.stderr
         assert "executed 3 real_calls 3 buffers 1" in out.stdout
 
+    def test_executable_outputs_charged(self, tokend):
+        """Execute's output buffers allocate HBM without any upload hook:
+        the shim must charge them on first sighting (VERDICT r2 #1)."""
+        out, stat = self._run_driver(
+            tokend, ["1", "--outputs", "2"],
+            extra_env={"FAKE_OUTPUT_BYTES": "300000"},
+        )
+        assert out.returncode == 0, out.stderr
+        assert "outputs_collected 2" in out.stdout
+        # both outputs held at exit -> 2 x 300000 still charged
+        assert stat["pods"]["ns/pod-a"]["mem_used"] == 600000
+
+    def test_outputs_over_cap_deny_until_destroy(self, tokend):
+        """Outputs pushing past the cap flip the pod into an over-cap state:
+        the next execute AND the next upload are denied (RESOURCE_EXHAUSTED)
+        until output destroys clear the overflow (VERDICT r2 #1 'done'
+        criterion: a compiled program's outputs push past the cap and the
+        next upload/execute is denied)."""
+        out, stat = self._run_driver(
+            tokend, ["3", "--outputs", "1"],
+            extra_env={"FAKE_OUTPUT_BYTES": "600000"},  # cap 1000000
+        )
+        assert out.returncode == 0, out.stderr
+        # execute 0: output charged (600000 <= cap)
+        # execute 1: runs, but its output is DENIED -> overflow
+        # execute 2: denied outright - the pod is over cap
+        assert "execute_denied i=2 code=8" in out.stdout
+        assert "real_calls 2" in out.stdout
+        # the upload after the executes is denied too
+        assert "upload_denied code=8" in out.stdout
+        assert "buffers 0" in out.stdout
+        # broker ledger holds only the granted charge, never over cap
+        assert stat["pods"]["ns/pod-a"]["mem_used"] == 600000
+
+    def test_output_destroy_recovers_over_cap(self, tokend):
+        """Destroying the over-cap outputs clears the overflow: the upload
+        that follows goes through and the ledger returns to zero."""
+        out, stat = self._run_driver(
+            tokend,
+            ["2", "--outputs", "1", "--destroy-outputs"],
+            extra_env={"FAKE_OUTPUT_BYTES": "600000"},
+        )
+        assert out.returncode == 0, out.stderr
+        assert "outputs_destroyed 2" in out.stdout
+        assert "upload_ok" in out.stdout
+        assert stat["pods"]["ns/pod-a"]["mem_used"] == 0
+
+    def test_soft_mode_outputs_account_but_allow(self, tokend):
+        """Soft mode: over-cap outputs are logged + tracked, nothing is
+        denied — the operator-observability mode keeps working."""
+        out, stat = self._run_driver(
+            tokend, ["3", "--outputs", "1"],
+            extra_env={"FAKE_OUTPUT_BYTES": "600000",
+                       "TPUSHARE_MEM_ENFORCE": "soft"},
+        )
+        assert out.returncode == 0, out.stderr
+        assert "execute_denied" not in out.stdout
+        assert "real_calls 3" in out.stdout
+        assert "upload_ok" in out.stdout
+
+    def test_client_create_injects_allocator_cap(self, tokend):
+        """PJRT_Client_Create must receive memory_fraction/preallocate
+        create options so client-init preallocation obeys the pod's cap
+        (SURVEY §7.4's TPU-specific hard part)."""
+        out, _ = self._run_driver(
+            tokend, ["0", "--create-client"],
+            extra_env={"TPUSHARE_MEM_FRACTION": "0.5"},
+        )
+        assert out.returncode == 0, out.stderr
+        assert "client_ok options=memory_fraction=0.5000;preallocate=false;" \
+            in out.stdout
+
+    def test_client_create_fail_open_on_rejected_options(self, tokend):
+        """A plugin that rejects unknown create options must still get a
+        working client: the shim retries without the injected options."""
+        out, _ = self._run_driver(
+            tokend, ["0", "--create-client"],
+            extra_env={"TPUSHARE_MEM_FRACTION": "0.5",
+                       "FAKE_REJECT_CREATE_OPTIONS": "1"},
+        )
+        assert out.returncode == 0, out.stderr
+        # retry succeeded; the recorded options from the final (bare) call
+        # are empty
+        assert "client_ok options=\n" in out.stdout
+        assert "retrying without them" in out.stderr
+
+    def test_preload_exports_allocator_env(self, tokend):
+        """The shim's constructor translates TPUSHARE_MEM_FRACTION into the
+        XLA allocator env before the runtime starts — a preload-only pod
+        (no kubeshare_tpu import) still gets its client allocator capped."""
+        shim, _, _ = self._paths()
+        out = subprocess.run(
+            ["/bin/sh", "-c", "echo frac=$XLA_PYTHON_CLIENT_MEM_FRACTION "
+             "prealloc=$XLA_PYTHON_CLIENT_PREALLOCATE"],
+            env=dict(os.environ, LD_PRELOAD=shim,
+                     TPUSHARE_MEM_FRACTION="0.3500"),
+            capture_output=True, text=True, timeout=30,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "frac=0.3500 prealloc=false" in out.stdout
+
 
 class TestTsan:
     """Race detection for the token scheduler: hammer a TSAN build with
