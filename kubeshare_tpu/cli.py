@@ -234,7 +234,7 @@ def _make_cluster(args: argparse.Namespace):
     raise SystemExit(f"unknown cluster backend {backend}")
 
 
-def main(argv=None) -> int:
+def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="kubeshare_tpu")
     sub = parser.add_subparsers(dest="component", required=True)
 
@@ -311,8 +311,11 @@ def main(argv=None) -> int:
     p.add_argument("--gang-fraction", type=float, default=0.0,
                    help="fraction of arrivals that are coscheduled gangs")
     p.set_defaults(fn=cmd_simulate)
+    return parser
 
-    args = parser.parse_args(argv)
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
     return args.fn(args)
 
 

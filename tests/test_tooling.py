@@ -192,3 +192,64 @@ class TestDeployManifests:
             self.DEPLOY, "config", "kubeshare-config-v4-multihost.yaml"))
         elements, _, _ = build_cell_chains(config.cell_types)
         assert any(e.is_multi_nodes for e in elements.values())
+
+
+class TestContainerBuildSurface:
+    """The packaging surface the reference ships as docker/*/Dockerfile +
+    Makefile image targets (ref Makefile:1-20): one image, `make images`,
+    and a kind e2e that degrades to a SKIP without a container runtime."""
+
+    def test_dockerfile_copies_what_manifests_expect(self):
+        import yaml
+
+        dockerfile = open(os.path.join(REPO, "docker", "Dockerfile")).read()
+        # shim artifacts must land where node-daemon.yaml's shim-init copies
+        # them from (/opt/tpushare -> /kubeshare/library hostPath)
+        assert "/opt/tpushare/" in dockerfile
+        assert "libtpushim.so.1" in dockerfile
+        assert "libtpushare_client.so" in dockerfile
+        # tokend/pmgr on find_binary's search path
+        assert "/usr/local/bin" in dockerfile
+        assert "tpushare-tokend" in dockerfile and "tpushare-pmgr" in dockerfile
+        with open(os.path.join(REPO, "deploy", "node-daemon.yaml")) as fh:
+            daemon = list(yaml.safe_load_all(fh))[0]
+        init = daemon["spec"]["template"]["spec"]["initContainers"][0]
+        assert "/opt/tpushare/libtpushim.so.1" in init["command"][-1]
+
+    def test_make_image_check(self):
+        out = subprocess.run(
+            ["make", "image-check"], cwd=REPO, capture_output=True, text=True,
+            timeout=300,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "image-check: ok" in out.stdout
+
+    def test_make_images_reports_missing_runtime(self):
+        """Without docker/podman, `make images` must fail loudly with the
+        exact build command — never pretend an image was produced."""
+        env = dict(os.environ, DOCKER="")
+        out = subprocess.run(
+            ["make", "images"], cwd=REPO, env=env, capture_output=True,
+            text=True, timeout=300,
+        )
+        if out.returncode == 0:  # a container runtime exists on this host
+            assert "docker build" in out.stdout or "podman" in out.stdout
+        else:
+            assert "neither docker nor podman found" in out.stderr
+
+    def test_e2e_kind_runs_to_kubectl_boundary(self):
+        out = subprocess.run(
+            ["sh", os.path.join(REPO, "deploy", "e2e-kind.sh")],
+            cwd=REPO, capture_output=True, text=True, timeout=600,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "manifests parse: ok" in out.stdout
+        assert "fake-cluster placement: ok" in out.stdout
+        # on container-less hosts the script must skip, not fail
+        assert ("SKIP" in out.stdout) or ("PASS" in out.stdout)
+
+    def test_vendored_pjrt_header_builds_shim(self):
+        header = os.path.join(REPO, "native", "third_party", "xla", "pjrt",
+                              "c", "pjrt_c_api.h")
+        assert os.path.isfile(header)
+        assert "The OpenXLA Authors" in open(header).read()[:200]
