@@ -7,6 +7,7 @@ from .transformer import (
     transformer_apply_with_aux,
     transformer_apply_ring,
     transformer_apply_pipelined,
+    transformer_train_1f1b,
     transformer_sharding_rules,
 )
 from .decoding import greedy_decode, init_kv_cache, prefill, sample_decode
@@ -14,6 +15,7 @@ from .decoding import greedy_decode, init_kv_cache, prefill, sample_decode
 __all__ = [
     "transformer_apply_ring",
     "transformer_apply_pipelined",
+    "transformer_train_1f1b",
     "transformer_sharding_rules",
     "greedy_decode",
     "init_kv_cache",

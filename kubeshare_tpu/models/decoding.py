@@ -79,18 +79,20 @@ def _decode_one(params, config: TransformerConfig, cache: Dict, token: jax.Array
         x = x + jnp.einsum("bhsk,hkd->bsd", o, layer["attn"]["wo"].astype(dtype))
         y = _rms_norm(x, layer["norm2"]["scale"])
         if "moe" in layer:
-            # single-token MoE step: routing is per-token (top-1 argmax).
-            # The step only sees batch-many tokens, so a factor-derived
+            # single-token MoE step: routing is per-token (top-k).  The
+            # step only sees batch-many tokens, so a factor-derived
             # capacity would collapse to ~1 and silently drop rows that
-            # share an expert; capacity=batch guarantees no drops and the
-            # buffer stays tiny.
+            # share an expert; capacity=batch guarantees no drops (each
+            # token routes to an expert at most once) and the buffer
+            # stays tiny.
             from ..ops.moe import MoEConfig, moe_apply
 
             e, d_m, f = layer["moe"]["w_in"].shape
             out, _ = moe_apply(
                 layer["moe"], y,
                 MoEConfig(d_model=d_m, d_ff=f, num_experts=e,
-                          capacity_factor=config.moe_capacity_factor),
+                          capacity_factor=config.moe_capacity_factor,
+                          top_k=config.moe_top_k),
                 capacity=y.shape[0] * y.shape[1],
             )
             x = x + out.astype(dtype)

@@ -38,11 +38,12 @@ class TransformerConfig:
     attention_window: Optional[int] = None  # sliding-window (local) size
     positional: str = "learned"  # learned | rope
     remat: bool = False  # jax.checkpoint each layer (HBM for FLOPs)
-    # MoE: every Nth layer's MLP becomes a top-1-routed expert mixture
+    # MoE: every Nth layer's MLP becomes a top-k-routed expert mixture
     # (ops.moe dense dispatch); None = all-dense
     moe_every: Optional[int] = None
     moe_num_experts: int = 8
     moe_capacity_factor: float = 1.25
+    moe_top_k: int = 1
 
     def layer_is_moe(self, layer_idx: int) -> bool:
         return (self.moe_every is not None
@@ -96,7 +97,8 @@ def transformer_init(rng: jax.Array, config: TransformerConfig) -> Dict:
                 next(keys),
                 MoEConfig(d_model=d, d_ff=f,
                           num_experts=config.moe_num_experts,
-                          capacity_factor=config.moe_capacity_factor),
+                          capacity_factor=config.moe_capacity_factor,
+                          top_k=config.moe_top_k),
             )
         else:
             layer["mlp"] = {
@@ -147,13 +149,13 @@ def _forward(params, tokens, config, attention_fn, pos_offset):
         # rematerialize each layer's activations in the backward pass —
         # the standard HBM-for-FLOPs trade for long sequences / deep stacks
         layer_fn = jax.checkpoint(
-            _layer_forward, static_argnums=(2, 3, 5)
+            _layer_forward, static_argnums=(2, 3, 5, 6)
         )
     aux_total = jnp.float32(0.0)
     for layer in params["layers"]:
         x, aux = layer_fn(layer, x, attention_fn, dtype,
                           positions if use_rope else None,
-                          config.moe_capacity_factor)
+                          config.moe_capacity_factor, config.moe_top_k)
         aux_total = aux_total + aux
 
     x = _rms_norm(x, params["final_norm"]["scale"])
@@ -161,7 +163,7 @@ def _forward(params, tokens, config, attention_fn, pos_offset):
 
 
 def _layer_forward(layer, x, attention_fn, dtype, rope_positions_or_none,
-                   moe_capacity_factor: float = 1.25):
+                   moe_capacity_factor: float = 1.25, moe_top_k: int = 1):
     """One transformer layer; returns (x, aux) where aux is the MoE
     load-balancing loss (0.0 for dense-MLP layers)."""
     # attention block
@@ -183,7 +185,8 @@ def _layer_forward(layer, x, attention_fn, dtype, rope_positions_or_none,
         out, aux = moe_apply(
             layer["moe"], y,
             MoEConfig(d_model=d, d_ff=f, num_experts=e,
-                      capacity_factor=moe_capacity_factor),
+                      capacity_factor=moe_capacity_factor,
+                      top_k=moe_top_k),
         )
         return x + out.astype(dtype), aux
     y = jax.nn.gelu(y @ layer["mlp"]["w_in"].astype(dtype))
@@ -402,12 +405,38 @@ def transformer_apply_pipelined(
     instead of competing with it.  ``use_flash=None`` auto-selects the
     Pallas-fused bodies exactly like the standalone sp entry points
     (ring_flash_auto / the kernel threshold at full sequence)."""
-    from ..parallel.pipeline import pipeline_apply, stack_stage_params
+    from ..parallel.pipeline import pipeline_apply
+
+    stacked, stage_fn, activation_spec, stage_check_vma = (
+        _pipeline_stage_setup(params, tokens.shape[1], config, mesh,
+                              pp_axis, seq_axis, use_flash, interpret))
+    dtype = config.dtype
+    x = params["embed"][tokens].astype(dtype)
+    if config.positional != "rope":
+        x = x + params["pos_embed"][: tokens.shape[1]].astype(dtype)
+
+    x = pipeline_apply(stacked, x, stage_fn, mesh, num_microbatches, pp_axis,
+                       activation_spec=activation_spec,
+                       check_vma=stage_check_vma)
+    x = _rms_norm(x, params["final_norm"]["scale"])
+    return (x @ params["lm_head"].astype(dtype)).astype(jnp.float32)
+
+
+def _pipeline_stage_setup(params, seq_len, config, mesh, pp_axis, seq_axis,
+                          use_flash, interpret):
+    """Shared pipeline construction: stack layers into pp stages and build
+    the stage body (with ring/Ulysses attention inside the stage when the
+    config asks for sequence parallelism).  Returns
+    ``(stacked_params, stage_fn, activation_spec, check_vma)``."""
+    from ..parallel.pipeline import stack_stage_params
 
     sp_attention = config.attention in ("ring", "ulysses")
     if sp_attention:
         _validate_sp_entry(config.attention, config, mesh, seq_axis)
-    elif config.moe_every is not None:
+    if config.moe_every is not None:
+        # applies to the sp branch too: the stage body would silently run
+        # MoE layers with default routing hyperparameters and drop the
+        # aux loss
         raise ValueError(
             "MoE layers are not supported on the pipelined path yet")
     n_stages = mesh.shape[pp_axis]
@@ -418,10 +447,6 @@ def transformer_apply_pipelined(
     per_stage = config.n_layers // n_stages
     dtype = config.dtype
     use_rope = config.positional == "rope"
-
-    x = params["embed"][tokens].astype(dtype)
-    if not use_rope:
-        x = x + params["pos_embed"][: tokens.shape[1]].astype(dtype)
 
     # stack each stage's layers: leaves [pp, per_stage, ...]
     stages = [
@@ -437,7 +462,7 @@ def transformer_apply_pipelined(
 
         ring_use_flash = use_flash
         if config.attention == "ring" and ring_use_flash is None:
-            ring_use_flash = ring_flash_auto(tokens.shape[1], mesh, seq_axis,
+            ring_use_flash = ring_flash_auto(seq_len, mesh, seq_axis,
                                              interpret)
 
         def stage_fn(stage_layers, x):
@@ -468,7 +493,7 @@ def transformer_apply_pipelined(
                        else (use_flash if use_flash is not None else interpret))
         stage_check_vma = not (force_flash and interpret)
     else:
-        positions = rope_positions(tokens.shape[1], 0) if use_rope else None
+        positions = rope_positions(seq_len, 0) if use_rope else None
         attention_fn = _select_attention(config)
 
         def stage_fn(stage_layers, x):
@@ -483,8 +508,85 @@ def transformer_apply_pipelined(
         activation_spec = None
         stage_check_vma = True
 
-    x = pipeline_apply(stacked, x, stage_fn, mesh, num_microbatches, pp_axis,
-                       activation_spec=activation_spec,
-                       check_vma=stage_check_vma)
-    x = _rms_norm(x, params["final_norm"]["scale"])
-    return (x @ params["lm_head"].astype(dtype)).astype(jnp.float32)
+    return stacked, stage_fn, activation_spec, stage_check_vma
+
+
+def transformer_train_1f1b(
+    params: Dict,
+    tokens: jax.Array,
+    targets: jax.Array,
+    config: TransformerConfig,
+    mesh: Mesh,
+    num_microbatches: int = 2,
+    pp_axis: str = "pp",
+    seq_axis: str = "sp",
+    use_flash: Optional[bool] = None,
+    interpret: bool = False,
+):
+    """Full flagship training step under the 1F1B pipeline schedule:
+    cross-entropy loss and gradients for EVERY parameter — embedding and
+    positional table (backpropped from the pipeline's input cotangents),
+    per-stage layer stacks (1F1B proper), and final norm + lm_head
+    (trained at the last stage via the pipeline's loss-param path).
+
+    Composes with sequence parallelism exactly like
+    :func:`transformer_apply_pipelined`: ``attention="ring"``/``"ulysses"``
+    runs the sp collectives inside each stage while microbatches hop
+    stages (1F1B x sp, the flagship schedule).  Returns ``(loss, grads)``
+    with ``grads`` matching the ``params`` pytree.
+    """
+    from ..parallel.pipeline import pipeline_train_1f1b
+
+    stacked, stage_fn, activation_spec, stage_check_vma = (
+        _pipeline_stage_setup(params, tokens.shape[1], config, mesh,
+                              pp_axis, seq_axis, use_flash, interpret))
+    dtype = config.dtype
+    use_rope = config.positional == "rope"
+    seq = tokens.shape[1]
+
+    x = params["embed"][tokens].astype(dtype)
+    if not use_rope:
+        x = x + params["pos_embed"][:seq].astype(dtype)
+
+    loss_params = {"final_norm": params["final_norm"],
+                   "lm_head": params["lm_head"]}
+
+    from ..parallel.train import cross_entropy_loss
+
+    def loss_fn(lp, out, y):
+        z = _rms_norm(out.astype(dtype), lp["final_norm"]["scale"])
+        logits = (z @ lp["lm_head"].astype(dtype)).astype(jnp.float32)
+        return cross_entropy_loss(logits, y)
+
+    loss, stage_grads, head_grads, dx = pipeline_train_1f1b(
+        stacked, x, targets, stage_fn, loss_fn, mesh, num_microbatches,
+        pp_axis=pp_axis, activation_spec=activation_spec,
+        check_vma=stage_check_vma, loss_params=loss_params,
+        return_input_grads=True,
+    )
+
+    # backprop the embedding lookup from the pipeline's input cotangents:
+    # d(embed) is a scatter-add of dx over the token ids, d(pos_embed) the
+    # batch-sum at each position
+    dx32 = dx.astype(jnp.float32)
+    grads: Dict = {
+        "embed": jnp.zeros(params["embed"].shape, jnp.float32)
+        .at[tokens].add(dx32).astype(params["embed"].dtype),
+        "final_norm": head_grads["final_norm"],
+        "lm_head": head_grads["lm_head"],
+    }
+    if not use_rope:
+        dpos = dx32.sum(axis=0)
+        grads["pos_embed"] = (
+            jnp.zeros(params["pos_embed"].shape, jnp.float32)
+            .at[:seq].set(dpos).astype(params["pos_embed"].dtype)
+        )
+    # unstack [pp, per_stage, ...] grads back into the per-layer list
+    n_stages = mesh.shape[pp_axis]
+    per_stage = config.n_layers // n_stages
+    grads["layers"] = [
+        jax.tree.map(lambda g, s=s, l=l: g[s, l], stage_grads)
+        for s in range(n_stages)
+        for l in range(per_stage)
+    ]
+    return loss, grads

@@ -1,8 +1,9 @@
 """Mixture-of-Experts layer with expert parallelism over an ``ep`` mesh axis.
 
-Experts are sharded across devices; tokens are routed top-1 and exchanged
-with the expert owners via a dense one-hot dispatch einsum whose contraction
-XLA lowers to an all-to-all over ICI when the expert axis is sharded.  Dense
+Experts are sharded across devices; tokens are routed top-k (top-1 Switch
+style by default, top-2 GShard style via ``top_k=2``) and exchanged with the
+expert owners via a dense one-hot dispatch einsum whose contraction XLA
+lowers to an all-to-all over ICI when the expert axis is sharded.  Dense
 dispatch keeps everything static-shaped and MXU-friendly (no ragged
 gathers); capacity_factor bounds the per-expert buffer exactly like
 token-dropping MoE implementations.
@@ -25,6 +26,9 @@ class MoEConfig:
     d_ff: int = 1024
     num_experts: int = 8
     capacity_factor: float = 1.25
+    # routing fan-out: 1 = Switch (gate is the raw top prob), >1 = GShard
+    # style (gates renormalized over the chosen experts)
+    top_k: int = 1
 
 
 def moe_init(rng: jax.Array, config: MoEConfig) -> Dict:
@@ -47,40 +51,56 @@ def moe_apply(
 ) -> Tuple[jax.Array, jax.Array]:
     """x: [batch, seq, d_model] -> (output, aux_loss).
 
-    Top-1 routing with capacity-bounded dense dispatch; aux_loss is the
-    standard load-balancing term (mean_prob * mean_assignment * E).
+    Top-k routing with capacity-bounded dense dispatch; aux_loss is the
+    standard load-balancing term (mean_prob * mean_first_choice * E).
+    With ``top_k=1`` the gate is the raw top probability (Switch); with
+    ``top_k>1`` gates are renormalized over the chosen experts (GShard).
 
     ``capacity`` overrides the derived per-expert buffer size; pass
-    ``capacity=n_tokens`` to guarantee no token is ever dropped (the
-    incremental-decode path relies on this).
+    ``capacity=n_tokens`` to guarantee no token-choice is ever dropped
+    (a token routes to each expert at most once, so n slots always
+    suffice — the incremental-decode path relies on this).
     """
     b, s, d = x.shape
     e = config.num_experts
+    k = config.top_k
+    if not 1 <= k <= e:
+        raise ValueError(f"top_k must be in [1, num_experts], got {k}")
     tokens = x.reshape(b * s, d)
     n = tokens.shape[0]
     if capacity is None:
-        capacity = max(1, math.ceil(config.capacity_factor * n / e))
+        capacity = max(1, math.ceil(config.capacity_factor * k * n / e))
     elif capacity < 1:
         raise ValueError(f"capacity must be >= 1, got {capacity}")
 
     logits = tokens @ params["router"]  # [n, e]
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    expert_index = jnp.argmax(probs, axis=-1)  # [n]
-    expert_gate = jnp.max(probs, axis=-1)  # [n]
+    topk_gate, topk_index = jax.lax.top_k(probs, k)  # [n, k]
+    if k > 1:
+        topk_gate = topk_gate / jnp.sum(topk_gate, axis=-1, keepdims=True)
 
-    # position of each token within its expert's buffer; beyond-capacity
-    # tokens are dropped (standard token-dropping MoE)
-    onehot = jax.nn.one_hot(expert_index, e, dtype=jnp.int32)  # [n, e]
-    position_in_expert = jnp.cumsum(onehot, axis=0) * onehot  # 1-based
-    within_capacity = (position_in_expert <= capacity) & (onehot > 0)
-    position = (position_in_expert - 1).max(axis=-1)  # [n]
-    kept = within_capacity.any(axis=-1)  # [n]
+    # Buffer-slot assignment runs choice-rank-major: every token's first
+    # choice claims a slot before any token's second choice, so overflow
+    # drops the weakest assignments first.  Flatten [n, k] -> [k*n] in that
+    # order, then the top-1 cumsum trick applies unchanged; beyond-capacity
+    # assignments are dropped (standard token-dropping MoE).
+    onehot = jax.nn.one_hot(topk_index, e, dtype=jnp.int32)  # [n, k, e]
+    onehot_flat = onehot.transpose(1, 0, 2).reshape(k * n, e)
+    position_in_expert = jnp.cumsum(onehot_flat, axis=0) * onehot_flat  # 1-based
+    within_capacity = (position_in_expert <= capacity) & (onehot_flat > 0)
+    position = (position_in_expert - 1).max(axis=-1)  # [k*n]
 
-    # dense dispatch tensor [n, e, capacity]
-    dispatch = (
+    # per-choice dense dispatch [k, n, e, capacity]; choices occupy
+    # disjoint slots, so summing over k gives the 0/1 input dispatch
+    dispatch_k = (
         within_capacity[:, :, None]
         & (jax.nn.one_hot(position, capacity, dtype=jnp.int32)[:, None, :] > 0)
-    ).astype(x.dtype)
+    ).astype(x.dtype).reshape(k, n, e, capacity)
+    dispatch = dispatch_k.sum(axis=0)  # [n, e, capacity]
+    # combine weights fold in the (kept-masked) per-choice gates
+    combine = jnp.einsum(
+        "kn,knec->nec", topk_gate.T.astype(x.dtype), dispatch_k
+    )
 
     expert_inputs = jnp.einsum("nec,nd->ecd", dispatch, tokens)  # [e, cap, d]
     hidden = jax.nn.gelu(
@@ -89,11 +109,10 @@ def moe_apply(
     expert_outputs = jnp.einsum(
         "ecf,efd->ecd", hidden, params["w_out"].astype(x.dtype)
     )
-    combined = jnp.einsum("nec,ecd->nd", dispatch, expert_outputs)
-    combined = combined * (expert_gate * kept)[:, None].astype(x.dtype)
+    combined = jnp.einsum("nec,ecd->nd", combine, expert_outputs)
 
-    # load-balancing auxiliary loss (Switch-style)
-    assignment_fraction = jnp.mean(onehot.astype(jnp.float32), axis=0)
+    # load-balancing auxiliary loss over first choices (Switch/GShard style)
+    assignment_fraction = jnp.mean(onehot[:, 0, :].astype(jnp.float32), axis=0)
     mean_probs = jnp.mean(probs, axis=0)
     aux_loss = jnp.sum(assignment_fraction * mean_probs) * e
 

@@ -187,13 +187,15 @@ def pipeline_train_1f1b(
     x: jax.Array,
     y: jax.Array,
     stage_fn: StageFn,
-    loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
+    loss_fn: Callable[..., jax.Array],
     mesh: Mesh,
     num_microbatches: int,
     pp_axis: str = "pp",
     activation_spec: "P | None" = None,
     target_spec: "P | None" = None,
     check_vma: bool = True,
+    loss_params: Any = None,
+    return_input_grads: bool = False,
 ):
     """One pipelined training step under the 1F1B schedule.
 
@@ -210,6 +212,14 @@ def pipeline_train_1f1b(
     per-shard param grads psum'd over the sharded axes (same contract as
     data parallelism; requires ``loss_fn`` to be a mean over the sharded
     axis, like cross-entropy over tokens).
+
+    ``loss_params`` (optional) is a replicated pytree the last stage's
+    loss consumes — ``loss_fn(loss_params, out, y)`` — e.g. a model head
+    trained jointly with the stages; its gradients are appended to the
+    return.  ``return_input_grads=True`` additionally returns
+    ``d(loss)/d(x)`` so the caller can continue the backward into
+    whatever produced ``x`` (an embedding lookup, a previous pipeline).
+    Full return shape: ``(loss, param_grads[, loss_param_grads][, dx])``.
     """
     n_stages = mesh.shape[pp_axis]
     extra_axes = _validate_activation_spec(activation_spec, pp_axis)
@@ -218,6 +228,13 @@ def pipeline_train_1f1b(
             "activation_spec with check_vma=False is unsupported: the "
             "sharded-axis gradient reduction relies on vma-typed "
             "autodiff psum-ing the invariant params' cotangents"
+        )
+    if loss_params is not None and not check_vma:
+        raise ValueError(
+            "loss_params with check_vma=False is unsupported: the "
+            "loss-param cotangent reduction over the pipeline axis "
+            "relies on vma-typed autodiff psum-ing invariant inputs' "
+            "cotangents"
         )
     if x.shape[0] % num_microbatches != 0:
         raise ValueError(
@@ -232,8 +249,10 @@ def pipeline_train_1f1b(
 
     param_specs = jax.tree.map(lambda _: P(pp_axis), stage_params)
     slots = min(num_microbatches, 2 * n_stages - 1)
+    lparams_in = loss_params if loss_params is not None else {}
+    lparam_specs = jax.tree.map(lambda _: P(), lparams_in)
 
-    def staged(params, x, y):
+    def staged(params, lparams, x, y):
         stage = jax.lax.axis_index(pp_axis)
         local_params = jax.tree.map(lambda p: p[0], params)
         mb = x.shape[0] // num_microbatches
@@ -252,8 +271,12 @@ def pipeline_train_1f1b(
         varying_zero = (varying_idx * 0).astype(micro_x.dtype)
 
         def stage_out_shape():
+            # the probe input must carry the same varying-axes type as the
+            # real stage inputs (scan-based stage bodies type-check their
+            # carry even under eval_shape)
             probe = jax.eval_shape(
-                lambda p, xin: stage_fn(p, xin), local_params, micro_x[0]
+                lambda p, xin: stage_fn(p, xin),
+                local_params, micro_x[0] + varying_zero,
             )
             return probe.shape, probe.dtype
 
@@ -272,10 +295,27 @@ def pipeline_train_1f1b(
             lambda p: jnp.zeros(p.shape, jnp.float32) + pp_zero,
             local_params,
         )
+        # loss-param cotangents arrive ALREADY psum'd over pp (lparams are
+        # pp-invariant, so vma-typed autodiff reduces their cotangents
+        # inside jax.vjp — same mechanism as the sp note below); the vjp
+        # SEED is masked to the last stage's valid window instead, so the
+        # psum'd value is exactly the last stage's contribution and the
+        # accumulator stays invariant
+        lgrads0 = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), lparams
+        )
+        # input cotangents land on stage 0 (full microbatch layout); the
+        # accumulator only exists when the caller asked for them — it
+        # costs an input-sized f32 carry plus a closing pp all-reduce
+        dx0 = (
+            jnp.zeros(micro_x.shape, jnp.float32)
+            + varying_zero.astype(jnp.float32)
+            if return_input_grads else jnp.zeros((), jnp.float32)
+        )
         loss0 = jnp.zeros((), jnp.float32) + varying_zero.astype(jnp.float32)
 
         def tick(t, carry):
-            fwd_carry, bwd_carry, stash, loss_sum, grads = carry
+            fwd_carry, bwd_carry, stash, loss_sum, grads, lgrads, dx_acc = carry
 
             # ---- forward sub-phase: microbatch m_f = t - s ----
             m_f = t - stage
@@ -291,18 +331,39 @@ def pipeline_train_1f1b(
 
             # last stage: loss value + backward seed for this microbatch
             y_true = micro_y[safe_f]
-            loss_val, loss_vjp = jax.vjp(
-                lambda out: loss_fn(out, y_true), y_out.astype(jnp.float32)
-            )
-            # cotangent must carry the same varying-axes type as the primal
+            is_last = stage == n_stages - 1
+            if loss_params is not None:
+                loss_val, loss_vjp = jax.vjp(
+                    lambda lp, out: loss_fn(lp, out, y_true),
+                    lparams, y_out.astype(jnp.float32),
+                )
+            else:
+                loss_val, loss_vjp = jax.vjp(
+                    lambda out: loss_fn(out, y_true), y_out.astype(jnp.float32)
+                )
+            # cotangent seed: 1/num_microbatches on the last stage during
+            # its valid window, 0 elsewhere — non-last stages' garbage
+            # losses then contribute exactly zero to the pp-psum'd
+            # loss-param cotangents.  (t - (n_stages-1) is the last
+            # stage's microbatch index, the same quantity f_valid checks
+            # there.)  The  + varying_zero  keeps the seed's varying-axes
+            # type equal to the primal's.
+            last_valid = (t >= n_stages - 1) & (t < n_stages - 1 + num_microbatches)
             seed = (
-                jnp.float32(1.0 / num_microbatches)
+                jnp.where(is_last & last_valid,
+                          jnp.float32(1.0 / num_microbatches), 0.0)
                 + varying_zero.astype(jnp.float32)
             )
-            (g_seed,) = loss_vjp(seed)
-            is_last = stage == n_stages - 1
+            if loss_params is not None:
+                g_lp, g_seed = loss_vjp(seed)
+            else:
+                (g_seed,) = loss_vjp(seed)
+                g_lp = {}
             loss_sum = loss_sum + jnp.where(
                 is_last & f_valid, loss_val / num_microbatches, 0.0
+            )
+            lgrads = jax.tree.map(
+                lambda acc, d: acc + d.astype(jnp.float32), lgrads, g_lp
             )
 
             # ---- backward sub-phase: microbatch m_b = t - 2(S-1) + s ----
@@ -322,6 +383,13 @@ def pipeline_train_1f1b(
                 lambda acc, d: acc + jnp.where(b_valid, d.astype(jnp.float32), 0.0),
                 grads, dparams,
             )
+            # stage 0's input cotangent is d(loss)/d(micro_x[m_b])
+            if return_input_grads:
+                dx_acc = jnp.where(
+                    (stage == 0) & b_valid,
+                    dx_acc.at[safe_b].set(dx.astype(jnp.float32)),
+                    dx_acc,
+                )
 
             # ---- hops ----
             fwd_carry = jax.lax.ppermute(y_out, pp_axis, fwd_perm)
@@ -329,13 +397,18 @@ def pipeline_train_1f1b(
                 jnp.where(b_valid, dx.astype(jnp.float32), jnp.zeros_like(dx, jnp.float32)),
                 pp_axis, bwd_perm,
             )
-            return fwd_carry, bwd_carry, stash, loss_sum, grads
+            return fwd_carry, bwd_carry, stash, loss_sum, grads, lgrads, dx_acc
 
-        _, _, _, loss_sum, grads = jax.lax.fori_loop(
-            0, n_ticks, tick, (fwd_carry0, bwd_carry0, stash0, loss0, grads0)
+        _, _, _, loss_sum, grads, lgrads, dx_acc = jax.lax.fori_loop(
+            0, n_ticks, tick,
+            (fwd_carry0, bwd_carry0, stash0, loss0, grads0, lgrads0, dx0),
         )
-        # loss lives on the last stage; share it
+        # loss lives on the last stage; share it.  Input cotangents live
+        # on stage 0 (the other stages accumulated zeros).  Loss-param
+        # cotangents are already pp-invariant (seed masking above).
         loss = jax.lax.psum(loss_sum, pp_axis)
+        dx_out = (jax.lax.psum(dx_acc, pp_axis).reshape(x.shape)
+                  if return_input_grads else dx_acc)
         if extra_axes:
             # sequence-sharded stages: each shard's loss_fn is a mean over
             # its LOCAL tokens, over-weighting every token by the shard
@@ -349,12 +422,18 @@ def pipeline_train_1f1b(
             for ax in extra_axes:
                 denom = denom * jax.lax.psum(1, ax)
             grads = jax.tree.map(lambda g: g / denom, grads)
+            # same local-mean over-weight correction applies to the
+            # loss-param cotangents (already sp-psum'd by vma autodiff)
+            # and the per-token input cotangents
+            lgrads = jax.tree.map(lambda g: g / denom, lgrads)
+            dx_out = dx_out / denom
         # grads: each stage keeps its own (restack leading axis of 1),
         # cast back to the param dtype so updates don't silently promote
         grads = jax.tree.map(
             lambda g, p: g[None].astype(p.dtype), grads, local_params
         )
-        return loss, grads
+        lgrads = jax.tree.map(lambda g, p: g.astype(p.dtype), lgrads, lparams)
+        return loss, grads, lgrads, dx_out
 
     x_spec = activation_spec if activation_spec is not None else P()
     # y may have a different rank than x (e.g. [batch, seq] targets vs
@@ -366,10 +445,17 @@ def pipeline_train_1f1b(
         y_spec = P(*tuple(activation_spec)[:y.ndim])
     else:
         y_spec = P()
-    return jax.shard_map(
+    dx_spec = x_spec if return_input_grads else P()
+    loss, grads, lgrads, dx_out = jax.shard_map(
         staged,
         mesh=mesh,
-        in_specs=(param_specs, x_spec, y_spec),
-        out_specs=(P(), param_specs),  # grads shard exactly like params
+        in_specs=(param_specs, lparam_specs, x_spec, y_spec),
+        out_specs=(P(), param_specs, lparam_specs, dx_spec),
         check_vma=check_vma,
-    )(stage_params, x, y)
+    )(stage_params, lparams_in, x, y)
+    out = [loss, grads]
+    if loss_params is not None:
+        out.append(lgrads)
+    if return_input_grads:
+        out.append(dx_out)
+    return tuple(out)

@@ -1184,6 +1184,57 @@ class TestMoEFlagship:
             transformer_apply_ring(params, jnp.zeros((2, 8), jnp.int32),
                                    config, mesh)
 
+    @pytest.mark.parametrize("attention", ["reference", "ring"])
+    def test_pipelined_paths_reject_moe(self, attention):
+        """Both pipelined branches (dense AND sp-in-stage) must refuse MoE
+        configs — the stage body would otherwise silently run MoE layers
+        with default routing hyperparameters and drop the aux loss."""
+        from jax.sharding import Mesh
+        from kubeshare_tpu.models.transformer import (
+            transformer_apply_pipelined, transformer_train_1f1b)
+
+        config = self._config(attention=attention, moe_every=1,
+                              positional="rope")
+        params = transformer_init(jax.random.PRNGKey(0), self._config())
+        shape = (2, 2) if attention == "ring" else (2,)
+        axes = ("pp", "sp") if attention == "ring" else ("pp",)
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(*shape)
+                    if attention == "ring"
+                    else np.array(jax.devices()[:2]).reshape(2), axes)
+        tokens = jnp.zeros((2, 8), jnp.int32)
+        with pytest.raises(ValueError, match="MoE"):
+            transformer_apply_pipelined(params, tokens, config, mesh)
+        with pytest.raises(ValueError, match="MoE"):
+            transformer_train_1f1b(params, tokens, tokens, config, mesh)
+
+    def test_top2_forward_grads_and_decode_parity(self):
+        """The flagship wired for GShard-style top-2 (config.moe_top_k=2):
+        forward + grads finite, and incremental decode matches the dense
+        forward — the dispatch/combine paths must agree for k>1 too."""
+        from kubeshare_tpu.models.decoding import prefill
+
+        config = self._config(moe_top_k=2)
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        tokens = jax.random.randint(jax.random.PRNGKey(8), (2, 12), 0, 64)
+        logits, aux = transformer_apply_with_aux(params, tokens, config)
+        assert np.isfinite(np.asarray(logits)).all()
+        assert float(aux) > 0.0
+
+        def loss(p):
+            lg, ax = transformer_apply_with_aux(p, tokens, config)
+            return cross_entropy_loss(lg, jnp.zeros_like(tokens)) + 0.01 * ax
+
+        grads = jax.grad(loss)(params)
+        for li in (1, 3):
+            g = np.asarray(grads["layers"][li]["moe"]["w_in"])
+            assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+        dense = transformer_apply(params, tokens, config)
+        _, last_logits = prefill(params, config, tokens)
+        np.testing.assert_allclose(
+            np.asarray(dense[:, -1]), np.asarray(last_logits),
+            rtol=2e-4, atol=2e-4)
+
     def test_decode_batch_independent_at_default_capacity(self):
         """Batched incremental decode must equal per-row decode even at the
         default capacity_factor (1.25): the decode path pins capacity to the

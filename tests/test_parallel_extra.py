@@ -32,6 +32,90 @@ class TestMoE:
         zero_rows = np.sum(np.all(np.asarray(out[0]) == 0.0, axis=-1))
         assert zero_rows >= 28  # capacity 1 per expert -> at most ~4 kept
 
+    @staticmethod
+    def _dense_reference(params, x, k):
+        """Route through EVERY expert densely, then keep the top-k mixture —
+        the semantics moe_apply's capacity-bounded dispatch must reproduce
+        when nothing is dropped."""
+        n = x.shape[0] * x.shape[1]
+        d = x.shape[-1]
+        tokens = x.reshape(n, d)
+        probs = jax.nn.softmax(tokens @ params["router"], axis=-1)
+        gate, idx = jax.lax.top_k(probs, k)
+        if k > 1:
+            gate = gate / jnp.sum(gate, axis=-1, keepdims=True)
+        hidden = jax.nn.gelu(jnp.einsum("nd,edf->enf", tokens, params["w_in"]))
+        outs = jnp.einsum("enf,efd->end", hidden, params["w_out"])  # [e, n, d]
+        out = sum(
+            gate[:, j, None] * outs[idx[:, j], jnp.arange(n)] for j in range(k)
+        )
+        return out.reshape(x.shape)
+
+    @pytest.mark.parametrize("top_k", [1, 2, 3])
+    def test_topk_matches_dense_reference_at_full_capacity(self, top_k):
+        config = MoEConfig(d_model=16, d_ff=32, num_experts=4, top_k=top_k)
+        params = moe_init(jax.random.PRNGKey(0), config)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16))
+        out, _ = moe_apply(params, x, config, capacity=16)
+        expected = self._dense_reference(params, x, top_k)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_top2_grads_reach_every_expert(self):
+        # with E=2 and top_k=2 every token touches both experts, so both
+        # experts' weights must receive gradient
+        config = MoEConfig(d_model=8, d_ff=16, num_experts=2, top_k=2,
+                           capacity_factor=2.0)
+        params = moe_init(jax.random.PRNGKey(0), config)
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, 8, 8))
+
+        grads = jax.grad(
+            lambda p: jnp.mean(moe_apply(p, x, config)[0] ** 2)
+        )(params)
+        g_in = np.asarray(grads["w_in"])
+        assert (np.abs(g_in).sum(axis=(1, 2)) > 0).all()
+
+    def test_top2_overflow_drops_second_choices_first(self):
+        # a router hard-biased so every token's first choice is expert 0 and
+        # second choice expert 1: with capacity exactly n, expert 0 keeps
+        # every first choice and the aux-capacity accounting never lets a
+        # second choice evict one
+        config = MoEConfig(d_model=4, d_ff=8, num_experts=2, top_k=2)
+        params = dict(moe_init(jax.random.PRNGKey(0), config))
+        params["router"] = jnp.array([[4.0, 2.0]] * 4)  # e0 always wins
+        x = jnp.abs(jax.random.normal(jax.random.PRNGKey(1), (1, 6, 4))) + 0.1
+        out_full, _ = moe_apply(params, x, config, capacity=6)
+        # capacity 6 fits all 6 first choices AND all 6 second choices
+        expected = self._dense_reference(params, x, 2)
+        np.testing.assert_allclose(np.asarray(out_full),
+                                   np.asarray(expected), rtol=1e-5, atol=1e-5)
+        # capacity 3: half of each expert's buffer — first choices beyond 3
+        # drop, but no kept token's gate is reweighted
+        out_small, _ = moe_apply(params, x, config, capacity=3)
+        kept_rows = np.any(np.asarray(out_small[0]) != 0.0, axis=-1)
+        assert kept_rows.sum() >= 3
+
+    def test_derived_capacity_includes_k(self):
+        # top_k=2, E=2, n=8, cf=1.0 -> capacity ceil(1.0*2*8/2)=8: nothing
+        # drops even when routing is maximally unbalanced per choice rank
+        config = MoEConfig(d_model=4, d_ff=8, num_experts=2,
+                           capacity_factor=1.0, top_k=2)
+        params = dict(moe_init(jax.random.PRNGKey(0), config))
+        params["router"] = jnp.array([[4.0, 2.0]] * 4)
+        x = jnp.abs(jax.random.normal(jax.random.PRNGKey(1), (1, 8, 4))) + 0.1
+        out, _ = moe_apply(params, x, config)
+        expected = self._dense_reference(params, x, 2)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("bad_k", [0, -1, 5])
+    def test_top_k_validated(self, bad_k):
+        config = MoEConfig(d_model=4, d_ff=8, num_experts=4, top_k=bad_k)
+        params = moe_init(jax.random.PRNGKey(0), config)
+        x = jnp.zeros((1, 2, 4))
+        with pytest.raises(ValueError, match="top_k"):
+            moe_apply(params, x, config)
+
     def test_expert_parallel_training(self):
         mesh = make_mesh(MeshSpec(dp=4, tp=2, sp=1))
         config = MoEConfig(d_model=16, d_ff=32, num_experts=4)
@@ -268,6 +352,109 @@ class TestPipelinedTransformer:
                 params, tokens, config, mesh, num_microbatches=2).sum()
 
         grads = jax.grad(loss)(params)
+        flat = jax.tree.leaves(grads)
+        assert all(np.isfinite(np.asarray(g)).all() for g in flat)
+        assert sum(float(np.abs(np.asarray(g)).sum()) for g in flat) > 0
+
+
+class TestTransformerTrain1F1B:
+    """transformer_train_1f1b: the FULL flagship training step under the
+    1F1B schedule — loss and grads for every parameter (embedding,
+    positional, all layers, final norm, lm_head) must be gradient-
+    equivalent to autodiff over the dense forward."""
+
+    @staticmethod
+    def _reference(params, tokens, targets, config):
+        from kubeshare_tpu.models.transformer import transformer_apply
+        from kubeshare_tpu.parallel.train import cross_entropy_loss
+
+        def loss(p):
+            return cross_entropy_loss(
+                transformer_apply(p, tokens, config), targets)
+
+        return jax.value_and_grad(loss)(params)
+
+    @pytest.mark.parametrize("positional", ["learned", "rope"])
+    def test_matches_dense_autodiff(self, positional):
+        from kubeshare_tpu.models.transformer import (
+            TransformerConfig, transformer_init, transformer_train_1f1b)
+
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("pp",))
+        config = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+            max_seq_len=32, dtype=jnp.float32, attention="reference",
+            positional=positional,
+        )
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+        targets = jax.random.randint(jax.random.PRNGKey(2), (4, 16), 0, 64)
+
+        loss, grads = transformer_train_1f1b(
+            params, tokens, targets, config, mesh, num_microbatches=2)
+        loss_ref, grads_ref = self._reference(params, tokens, targets, config)
+
+        np.testing.assert_allclose(float(loss), float(loss_ref),
+                                   rtol=1e-5, atol=1e-6)
+        flat, flat_ref = jax.tree.leaves(grads), jax.tree.leaves(grads_ref)
+        assert len(flat) == len(flat_ref)
+        for g, g_ref in zip(flat, flat_ref):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_1f1b_sp_ring_matches_dense_autodiff(self):
+        """1F1B x sp with ring attention in-stage — the flagship schedule:
+        gradients still match dense autodiff, every param included."""
+        from kubeshare_tpu.models.transformer import (
+            TransformerConfig, transformer_init, transformer_train_1f1b)
+
+        pp, sp = 2, 2
+        mesh = Mesh(np.array(jax.devices()[:pp * sp]).reshape(pp, sp),
+                    ("pp", "sp"))
+        config = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+            max_seq_len=32, dtype=jnp.float32, attention="ring",
+            positional="rope",
+        )
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        tokens = jax.random.randint(jax.random.PRNGKey(3), (4, 16), 0, 64)
+        targets = jax.random.randint(jax.random.PRNGKey(4), (4, 16), 0, 64)
+
+        loss, grads = transformer_train_1f1b(
+            params, tokens, targets, config, mesh, num_microbatches=2)
+        dense_config = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+            max_seq_len=32, dtype=jnp.float32, attention="reference",
+            positional="rope",
+        )
+        loss_ref, grads_ref = self._reference(
+            params, tokens, targets, dense_config)
+
+        np.testing.assert_allclose(float(loss), float(loss_ref),
+                                   rtol=1e-5, atol=1e-6)
+        for g, g_ref in zip(jax.tree.leaves(grads),
+                            jax.tree.leaves(grads_ref)):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                       rtol=5e-4, atol=5e-5)
+
+    def test_1f1b_sp_ulysses_runs(self):
+        """Ulysses all-to-all in-stage under 1F1B: finite loss + grads."""
+        from kubeshare_tpu.models.transformer import (
+            TransformerConfig, transformer_init, transformer_train_1f1b)
+
+        pp, sp = 2, 2
+        mesh = Mesh(np.array(jax.devices()[:pp * sp]).reshape(pp, sp),
+                    ("pp", "sp"))
+        config = TransformerConfig(
+            vocab_size=32, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+            max_seq_len=16, dtype=jnp.float32, attention="ulysses",
+            positional="rope",
+        )
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        tokens = jnp.ones((2, 8), jnp.int32)
+
+        loss, grads = transformer_train_1f1b(
+            params, tokens, tokens, config, mesh, num_microbatches=2)
+        assert np.isfinite(float(loss))
         flat = jax.tree.leaves(grads)
         assert all(np.isfinite(np.asarray(g)).all() for g in flat)
         assert sum(float(np.abs(np.asarray(g)).sum()) for g in flat) > 0
