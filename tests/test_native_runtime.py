@@ -925,6 +925,144 @@ class TestGangTokend:
         assert reply[0] == "ELIG" and reply[3] == "1"
 
 
+def _start_gang_quad(tmp_path):
+    """Four sibling tokends (a 2x2-slice-shaped gang): gang/pod-x shared on
+    all four chips, each tokend launched with -G naming the other three."""
+    config_dir = tmp_path / "config"
+    config_dir.mkdir(exist_ok=True)
+    for i in range(4):
+        # 64 MiB per-chip HBM cap for the pod (config column 4, bytes)
+        write_atomic(str(config_dir / f"chip-{i}"),
+                     f"1\ngang/pod-x 1.0 0.4 {64 << 20}\n")
+    ports = [free_port() for _ in range(4)]
+    procs = []
+    for i in range(4):
+        peers = ",".join(str(ports[j]) for j in range(4) if j != i)
+        procs.append(subprocess.Popen(
+            [TOKEND, "-p", str(config_dir), "-f", f"chip-{i}",
+             "-P", str(ports[i]), "-q", "50", "-m", "5", "-w", "1000",
+             "-G", peers],
+            stderr=subprocess.DEVNULL))
+    for port in ports:
+        wait_listening(port)
+    return procs, ports
+
+
+@pytest.fixture
+def gang_quad(tmp_path):
+    procs, ports = _start_gang_quad(tmp_path)
+    yield ports
+    for proc in procs:
+        proc.kill()
+        proc.wait()
+
+
+class TestGangQuad:
+    """-G past the pairwise fixture (VERDICT r2 #9): four live sibling
+    tokends must keep grants aligned, and the gang client's unwind
+    semantics must hold at width 4."""
+
+    def test_one_overloaded_chip_blocks_all_three_peers(self, gang_quad):
+        ports = gang_quad
+        c0 = TokenClient("127.0.0.1", ports[0], "gang/pod-x")
+        c0.acquire()
+        c0.release(2000.0)  # share 2.0 of a 1.0 window: over limit on chip-0
+        for port in ports[1:]:
+            reply = _raw_cmd(port, "REQ gang/pod-x 0")
+            assert reply.startswith("WAIT "), (port, reply)
+        # decay restores chip-0 -> every peer grants again
+        deadline = time.time() + 5
+        granted = set()
+        while time.time() < deadline and len(granted) < 3:
+            for port in ports[1:]:
+                if port not in granted and _raw_cmd(
+                        port, "REQ gang/pod-x 0").startswith("TOK "):
+                    granted.add(port)
+            time.sleep(0.1)
+        assert len(granted) == 3
+        c0.close()
+
+    def test_quad_soak_no_unilateral_runahead(self, gang_quad):
+        """Contention soak: chip-0 is pushed over limit while independent
+        clients hammer chips 1-3 for the whole decay window — none may
+        grant unilaterally, so no chip's charged time runs ahead."""
+        import json
+
+        ports = gang_quad
+        c0 = TokenClient("127.0.0.1", ports[0], "gang/pod-x")
+        c0.acquire()
+        c0.release(2000.0)
+
+        errors = []
+
+        def hammer(port):
+            deadline = time.monotonic() + 0.4
+            while time.monotonic() < deadline:
+                reply = _raw_cmd(port, "REQ gang/pod-x 0")
+                if reply.startswith("TOK "):
+                    errors.append((port, reply))
+                    return
+                time.sleep(0.01)
+
+        threads = [threading.Thread(target=hammer, args=(p,))
+                   for p in ports[1:]]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, f"unilateral grants during overload: {errors}"
+        for port in ports[1:]:
+            charged = json.loads(_raw_cmd(port, "STAT"))[
+                "pods"]["gang/pod-x"]["charged_total_ms"]
+            assert charged == 0.0, (port, charged)
+        c0.close()
+
+    def test_gang_acquire_and_charge_spans_all_four(self, gang_quad):
+        import json
+
+        from kubeshare_tpu.isolation.client import GangTokenClient
+
+        ports = gang_quad
+        gang = GangTokenClient([
+            TokenClient("127.0.0.1", p, "gang/pod-x") for p in ports
+        ])
+        quota = gang.acquire()
+        assert quota > 0
+        gang.release(25.0)
+        for port in ports:
+            pod = json.loads(_raw_cmd(port, "STAT"))["pods"]["gang/pod-x"]
+            assert pod["grants"] == 1, (port, pod)
+            assert pod["charged_total_ms"] >= 25.0
+        gang.close()
+
+    def test_mem_deny_on_last_chip_rolls_back_first_three(self, gang_quad):
+        """HBM unwind at width 4: chip-3's ledger is pre-filled so the
+        gang charge denies there — the three already-charged chips must be
+        credited back, or the pod permanently loses headroom it never
+        used."""
+        ports = gang_quad
+        mib = 1 << 20
+        # fill chip-3 to 60 of the pod's 64 MiB per-chip cap
+        reply = _raw_cmd(ports[3], f"MEM gang/pod-x {60 * mib}")
+        assert reply.startswith("OK "), reply
+
+        from kubeshare_tpu.isolation.client import GangTokenClient
+
+        gang = GangTokenClient([
+            TokenClient("127.0.0.1", p, "gang/pod-x") for p in ports
+        ])
+        ok, _, _ = gang.request_memory(8 * mib)  # fits on 0-2, not on 3
+        assert not ok
+        for port in ports[:3]:
+            reply = _raw_cmd(port, "MEM gang/pod-x 0")
+            used = int(reply.split()[1])
+            assert used == 0, (port, reply)  # rolled back
+        # chip-3 still holds only its pre-fill
+        assert int(_raw_cmd(ports[3], "MEM gang/pod-x 0").split()[1]) \
+            == 60 * mib
+        gang.close()
+
+
 class TestSupervisorGangWiring:
     def test_gang_peer_ports_reach_tokend_cmdline(self, tmp_path):
         sup = ChipSupervisor(
