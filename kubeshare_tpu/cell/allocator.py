@@ -180,6 +180,14 @@ class CellAllocator:
         else:
             self._fit_gen_global += 1
 
+    def fit_generation(self, node: str) -> Tuple[int, int]:
+        """Version stamp of this node's allocation state: changes whenever
+        a reserve/reclaim touches the node or inventory/health changes
+        globally.  Callers key caches of node-state-derived values on it
+        (the Score fast path does)."""
+        with self.lock:
+            return (self._fit_gen_global, self._fit_node_gen.get(node, 0))
+
     # ------------------------------------------------------------------
     # fit checks (ref filter.go)
     # ------------------------------------------------------------------

@@ -106,6 +106,8 @@ class KubeShareScheduler:
         self.bound_pod_queue: Dict[str, List[Pod]] = {}
         self.bound_queue_lock = threading.RLock()
         self._suppressed_deletes: set = set()
+        # (node, model, kind) -> (fit_generation, node-local score)
+        self._node_score_cache: Dict[tuple, tuple] = {}
 
         cluster.add_node_handler(self._on_node_event)
         cluster.add_pod_handler(self._on_pod_event)
@@ -300,7 +302,7 @@ class KubeShareScheduler:
         assert status is not None
 
         bitmap = self._port_bitmap(node_name)
-        if bitmap.find_next_from_current() == -1:
+        if not bitmap.has_free():
             return Status(
                 Status.UNSCHEDULABLE, f"node {node_name} pod manager port pool is full"
             )
@@ -351,9 +353,25 @@ class KubeShareScheduler:
             return self._opportunistic_node_score(node_name, status)
         return self._guarantee_node_score(node_name, status)
 
+    def _score_cache_get(self, node_name: str, model: str, kind: str):
+        """Node-local score fast path: both score bodies depend only on the
+        node's cell state (priority/availability), which the allocator
+        versions with fit generations — one (node, model) score survives
+        until something reserves/reclaims on that node.  Without this,
+        Score recomputes an O(cells) walk for every (pod, node) pair and
+        dominates large-cluster cycles (docs/perf.md 64-node dip)."""
+        gen = self.allocator.fit_generation(node_name)
+        hit = self._node_score_cache.get((node_name, model, kind))
+        if hit is not None and hit[0] == gen:
+            return gen, hit[1]
+        return gen, None
+
     def _opportunistic_node_score(self, node_name: str, status: PodStatus) -> float:
         """Packing score (ref score.go:42-68): prefer busy, high-priority
         cells; penalize breaking into free chips."""
+        gen, cached = self._score_cache_get(node_name, status.model, "opp")
+        if cached is not None:
+            return cached
         cells = self.allocator.leaf_cells_by_node(node_name, status.model)
         if not cells:
             return 0.0
@@ -367,23 +385,42 @@ class KubeShareScheduler:
                 score += (1 - cell.available) * 100
         n = float(len(cells))
         score -= free_leaves / n * 100
-        return score / n
+        score /= n
+        self._node_score_cache[(node_name, status.model, "opp")] = (gen, score)
+        return score
 
     def _guarantee_node_score(self, node_name: str, status: PodStatus) -> float:
         """Performance + locality score (ref score.go:85-112): prefer idle,
-        high-priority cells near the pod's gang peers."""
-        cells = self.allocator.leaf_cells_by_node(node_name, status.model)
-        if not cells:
-            return 0.0
+        high-priority cells near the pod's gang peers.  The node-local
+        part is generation-cached; the peer-locality part depends on the
+        pod's gang and is computed fresh (cell coordinates are static, so
+        it only costs when the pod actually has placed peers)."""
+        cells = None
+        gen, node_part = self._score_cache_get(node_name, status.model, "guar")
+        if node_part is None:
+            cells = self.allocator.leaf_cells_by_node(node_name, status.model)
+            if not cells:
+                return 0.0
+            node_part = sum(
+                self.chip_priority.get(cell.cell_type, 0)
+                - (1 - cell.available) * 100
+                for cell in cells
+            ) / float(len(cells))
+            self._node_score_cache[(node_name, status.model, "guar")] = (
+                gen, node_part)
         peers = self.group_peer_cells(status.pod_group)
+        if not peers:
+            return node_part
+        if cells is None:
+            cells = self.allocator.leaf_cells_by_node(node_name, status.model)
+            if not cells:
+                return 0.0
         n_peers = float(len(peers))
-        score = 0.0
-        for cell in cells:
-            score += self.chip_priority.get(cell.cell_type, 0) - (1 - cell.available) * 100
-            if n_peers:
-                locality = sum(self.cell_distance(cell, peer) for peer in peers)
-                score -= locality / n_peers * 100
-        return score / float(len(cells))
+        locality = sum(
+            self.cell_distance(cell, peer)
+            for cell in cells for peer in peers
+        )
+        return node_part - locality / n_peers * 100 / float(len(cells))
 
     def group_peer_cells(self, pod_group: str) -> List[Cell]:
         """Cells already held by pods of the same group (ref score.go:150-162)."""

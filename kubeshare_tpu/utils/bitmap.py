@@ -31,6 +31,10 @@ class Bitmap:
     def clear(self) -> None:
         self._bits = 0
 
+    def count_masked_below(self, length: int) -> int:
+        """Popcount of the first ``length`` positions."""
+        return (self._bits & ((1 << length) - 1)).bit_count()
+
     def find_next_and_set(self) -> int:
         pos = 0
         bits = self._bits
@@ -60,6 +64,11 @@ class RRBitmap:
             if not self._bitmap.is_masked(ii):
                 return ii
         return -1
+
+    def has_free(self) -> bool:
+        """O(1) pool-not-full check (popcount), for the Filter hot path —
+        find_next_from_current is an O(length) scan per call."""
+        return self._bitmap.count_masked_below(self._length) < self._length
 
     def find_next_from_current_and_set(self) -> int:
         """Claim and return the next free index in round-robin order; -1 if full."""

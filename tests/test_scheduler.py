@@ -694,6 +694,33 @@ class TestScoringFormulas:
         expected = (4 * 60 - 0.4 * 100) / 4
         assert abs(score - expected) < 1e-9
 
+    def test_score_cache_invalidated_by_reserve_and_reclaim(self):
+        """The generation-keyed node score cache must never serve a stale
+        value: binding a pod changes the node's packing score, deleting it
+        restores the original."""
+        from kubeshare_tpu.scheduler.podspec import PodStatus
+
+        cluster, plugin, engine = self._plugin()
+        status = PodStatus(namespace="default", name="x")
+        empty_opp = plugin._opportunistic_node_score("host-a", status)
+        empty_guar = plugin._guarantee_node_score("host-a", status)
+        # warm the cache, then change the node's allocation
+        assert plugin._opportunistic_node_score("host-a", status) == empty_opp
+        cluster.create_pod(shared_pod("seed", request="0.4", limit="1.0"))
+        engine.run_until_idle()
+        busy_opp = plugin._opportunistic_node_score("host-a", status)
+        busy_guar = plugin._guarantee_node_score("host-a", status)
+        assert busy_opp != empty_opp
+        assert busy_guar != empty_guar
+        assert abs(busy_opp - (4 * 60 + 0.4 * 100 - (3 / 4) * 100) / 4) < 1e-9
+        # reclaim restores the empty-node scores
+        cluster.delete_pod("default", "seed")
+        engine.run_until_idle()
+        assert abs(plugin._opportunistic_node_score("host-a", status)
+                   - empty_opp) < 1e-9
+        assert abs(plugin._guarantee_node_score("host-a", status)
+                   - empty_guar) < 1e-9
+
     def test_normalize_scores_reference_behavior(self):
         cluster, plugin, engine = self._plugin()
         # all within [0,100] after negative shift: returned shifted only

@@ -35,6 +35,19 @@ class TestBitmap:
 
 
 class TestRRBitmap:
+    def test_has_free_matches_scan(self):
+        """has_free (the O(1) Filter fast path) must agree with the
+        round-robin scan at every fill level, including full."""
+        bm = RRBitmap(8)
+        for i in range(8):
+            assert bm.has_free() == (bm.find_next_from_current() != -1)
+            assert bm.has_free()
+            bm.mask(i)
+        assert not bm.has_free()
+        assert bm.find_next_from_current() == -1
+        bm.unmask(3)
+        assert bm.has_free()
+
     def test_round_robin(self):
         # mirrors the port pool usage: Mask(0) then round-robin grants
         rr = RRBitmap(4)
