@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import statistics
 import time
 
 import jax
@@ -79,6 +80,11 @@ def bench_op(step_fn, carry, iters: int = 30, reps: int = 3) -> float:
     each pair rather than biasing a pooled min.  Reps with a non-positive
     difference (noise bigger than signal) are discarded; all-discarded
     returns NaN rather than a fabricated number.
+
+    The MEDIAN of the diffs is reported: differencing noise is one-sided
+    in effect (a slow short-chain rep shrinks the diff), so a pooled min
+    systematically under-reports — round 2's "2x drift" at (1,4,8192,128)
+    was exactly this, occasional too-fast outliers surviving min().
     """
     short, long_ = _make_chain(step_fn, iters), _make_chain(step_fn, 3 * iters)
     np.asarray(short(carry))  # compile + first run outside timing
@@ -95,7 +101,7 @@ def bench_op(step_fn, carry, iters: int = 30, reps: int = 3) -> float:
             diffs.append(d)
     if not diffs:
         return float("nan")
-    return min(diffs) / (2 * iters) * 1e3
+    return statistics.median(diffs) / (2 * iters) * 1e3
 
 
 def _qkv(b, h, s, d, seed=0, dtype=jnp.bfloat16):
@@ -248,10 +254,22 @@ def _train_flops_per_token(dims, seq):
     average visible length); plus the lm_head projection.  Backward is 2x
     forward for matmuls -> train = 3x forward.  Matches the convention of
     published MFU numbers (PaLM appendix B / the scaling-book recipe)."""
-    d, ff = dims["d_model"], dims["d_ff"]
-    n_layers, vocab = dims["n_layers"], dims["vocab_size"]
-    per_layer = 2 * (4 * d * d + 2 * d * ff) + 2 * seq * d
-    fwd = n_layers * per_layer + 2 * d * vocab
+    from kubeshare_tpu.models.transformer import TransformerConfig
+
+    config = TransformerConfig(**dims)
+    d, ff, vocab = config.d_model, config.d_ff, config.vocab_size
+    attn_proj = 2 * 4 * d * d
+    mlp = 2 * 2 * d * ff
+    attn = 2 * seq * d
+    fwd = 2 * d * vocab
+    for layer in range(config.n_layers):
+        # MoE placement comes from the model's own predicate so the FLOPs
+        # model tracks the real layer mix by construction.  A routed token
+        # runs top_k experts of the same (d, ff) shape; the router matmul
+        # and dispatch einsums are capacity-shaped overhead, deliberately
+        # NOT credited as useful FLOPs.
+        k = config.moe_top_k if config.layer_is_moe(layer) else 1
+        fwd += attn_proj + attn + mlp * k
     return 3 * fwd
 
 
@@ -276,6 +294,11 @@ MODEL_SIZES = {
                       max_seq_len=2048, vocab_size=32000), 2, 2048),
     "wide": (dict(d_model=2048, n_layers=8, n_heads=16, d_ff=8192,
                   max_seq_len=2048, vocab_size=32000), 1, 2048),
+    # every 2nd MLP an 8-expert top-2 mixture (the flagship moe_every path)
+    "moe": (dict(d_model=1024, n_layers=8, n_heads=8, d_ff=4096,
+                 max_seq_len=2048, vocab_size=32000, moe_every=2,
+                 moe_num_experts=8, moe_top_k=2,
+                 moe_capacity_factor=1.25), 2, 2048),
 }
 
 
