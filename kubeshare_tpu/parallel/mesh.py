@@ -9,8 +9,16 @@ pjit programs over these meshes).
 Axis vocabulary used across models/ops:
   dp  data parallel (batch split; gradients all-reduced by XLA)
   fsdp parameter sharding along dp (zero-style), optional
+  ep  expert parallel (MoE experts sharded; token dispatch all-to-all)
   tp  tensor parallel (head/feature split inside layers)
   sp  sequence parallel (ring attention shards the sequence axis)
+
+ep subdivides the batch dimension alongside dp (batch shards over
+(dp, ep); experts replicated over dp, sharded over ep), so the dispatch
+all-to-all stays within an ep group — the conventional GShard layout.
+For backward compatibility a mesh with ep == 1 keeps the historical
+three-axis ("dp", "tp", "sp") shape; ep > 1 inserts the "ep" axis
+between dp and tp.
 """
 
 from __future__ import annotations
@@ -23,18 +31,24 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, kw_only=True)
 class MeshSpec:
-    """Logical mesh shape; -1 on one axis absorbs remaining devices."""
+    """Logical mesh shape; -1 on one axis absorbs remaining devices.
+
+    Keyword-only: the ep axis sits between dp and tp, so positional
+    construction would silently reinterpret older (dp, tp, sp) calls.
+    """
 
     dp: int = -1
+    ep: int = 1
     tp: int = 1
     sp: int = 1
 
-    def resolve(self, n_devices: int) -> Tuple[int, int, int]:
-        known = [d for d in (self.dp, self.tp, self.sp) if d != -1]
+    def resolve(self, n_devices: int) -> Tuple[int, int, int, int]:
+        axes = (self.dp, self.ep, self.tp, self.sp)
+        known = [d for d in axes if d != -1]
         prod = int(np.prod(known)) if known else 1
-        if -1 in (self.dp, self.tp, self.sp):
+        if -1 in axes:
             if n_devices % prod != 0:
                 raise ValueError(
                     f"{n_devices} devices not divisible by fixed axes {prod}"
@@ -46,9 +60,7 @@ class MeshSpec:
                 raise ValueError(
                     f"mesh {self})={prod} devices != available {n_devices}"
                 )
-        dims = tuple(
-            (fill if d == -1 else d) for d in (self.dp, self.tp, self.sp)
-        )
+        dims = tuple((fill if d == -1 else d) for d in axes)
         return dims  # type: ignore[return-value]
 
 
@@ -57,7 +69,10 @@ def make_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
-    dp, tp, sp = spec.resolve(len(devices))
+    dp, ep, tp, sp = spec.resolve(len(devices))
+    if ep > 1:
+        array = np.array(devices).reshape(dp, ep, tp, sp)
+        return Mesh(array, ("dp", "ep", "tp", "sp"))
     array = np.array(devices).reshape(dp, tp, sp)
     return Mesh(array, ("dp", "tp", "sp"))
 
@@ -67,9 +82,10 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def batch_sharding(mesh: Mesh, ndim: int = 2, seq_axis: Optional[int] = None) -> NamedSharding:
-    """Shard axis 0 over dp; optionally a sequence axis over sp."""
-    spec = [None] * ndim
-    spec[0] = "dp"
+    """Shard axis 0 over dp (and ep, when the mesh has one); optionally a
+    sequence axis over sp."""
+    spec: list = [None] * ndim
+    spec[0] = ("dp", "ep") if mesh.shape.get("ep", 1) > 1 else "dp"
     if seq_axis is not None and mesh.shape.get("sp", 1) > 1:
         spec[seq_axis] = "sp"
     return NamedSharding(mesh, P(*spec))

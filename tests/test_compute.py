@@ -327,10 +327,22 @@ class TestShardedTraining:
         assert int(state.step) == 3
 
     def test_mesh_spec_resolution(self):
-        assert MeshSpec(dp=-1, tp=2, sp=2).resolve(8) == (2, 2, 2)
-        assert MeshSpec(dp=8, tp=1, sp=1).resolve(8) == (8, 1, 1)
+        assert MeshSpec(dp=-1, tp=2, sp=2).resolve(8) == (2, 1, 2, 2)
+        assert MeshSpec(dp=8, tp=1, sp=1).resolve(8) == (8, 1, 1, 1)
+        assert MeshSpec(dp=-1, ep=2, tp=2).resolve(8) == (2, 2, 2, 1)
         with pytest.raises(ValueError):
             MeshSpec(dp=3, tp=1, sp=1).resolve(8)
+
+    def test_mesh_axes_with_and_without_ep(self):
+        # ep == 1 keeps the historical three-axis shape (sharding rules
+        # that name only dp/tp/sp keep working unchanged)
+        assert make_mesh(MeshSpec(dp=2, tp=2, sp=2)).axis_names == (
+            "dp", "tp", "sp")
+        mesh = make_mesh(MeshSpec(dp=2, ep=2, tp=2))
+        assert mesh.axis_names == ("dp", "ep", "tp", "sp")
+        assert mesh.shape["ep"] == 2
+        # batch axis spans dp x ep so every device holds a batch shard
+        assert batch_sharding(mesh).spec == P(("dp", "ep"), None)
 
     def test_shard_params_rules(self):
         mesh = make_mesh(MeshSpec(dp=2, tp=2, sp=2))

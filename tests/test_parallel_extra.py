@@ -137,6 +137,36 @@ class TestMoE:
         assert np.isfinite(float(loss))
         assert np.isfinite(np.asarray(grads["router"])).all()
 
+    def test_dedicated_ep_axis_matches_unsharded(self):
+        """Experts over their own mesh axis (dp x ep composition, the
+        GShard layout): batch sharded over (dp, ep), experts over ep only
+        — forward and grads must equal the single-device computation."""
+        from kubeshare_tpu.parallel import batch_sharding
+
+        mesh = make_mesh(MeshSpec(dp=2, ep=2, tp=2))
+        config = MoEConfig(d_model=16, d_ff=32, num_experts=4, top_k=2,
+                           capacity_factor=8.0)
+        params = moe_init(jax.random.PRNGKey(0), config)
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 4, 16))
+
+        def loss_fn(params, x):
+            out, aux = moe_apply(params, x, config)
+            return jnp.mean(out**2) + 0.01 * aux
+
+        base_loss, base_grads = jax.value_and_grad(loss_fn)(params, x)
+
+        placed = shard_params(params, moe_sharding_rules(ep_axis="ep"), mesh)
+        assert placed["w_in"].sharding.spec == P("ep", None, None)
+        x_sharded = jax.device_put(x, batch_sharding(mesh, ndim=3))
+        loss, grads = jax.jit(jax.value_and_grad(loss_fn))(placed, x_sharded)
+
+        np.testing.assert_allclose(float(loss), float(base_loss),
+                                   rtol=1e-5, atol=1e-6)
+        for key in ("router", "w_in", "w_out"):
+            np.testing.assert_allclose(
+                np.asarray(grads[key]), np.asarray(base_grads[key]),
+                rtol=2e-4, atol=1e-5)
+
 
 class TestPipeline:
     def test_matches_sequential(self):
