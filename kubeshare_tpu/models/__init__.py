@@ -9,6 +9,7 @@ from .transformer import (
     transformer_apply_pipelined,
     transformer_train_1f1b,
     transformer_sharding_rules,
+    transformer_fsdp_rules,
 )
 from .decoding import greedy_decode, init_kv_cache, prefill, sample_decode
 
@@ -17,6 +18,7 @@ __all__ = [
     "transformer_apply_pipelined",
     "transformer_train_1f1b",
     "transformer_sharding_rules",
+    "transformer_fsdp_rules",
     "greedy_decode",
     "init_kv_cache",
     "prefill",

@@ -377,6 +377,37 @@ def transformer_sharding_rules() -> Dict[str, P]:
     }
 
 
+def transformer_fsdp_rules(axis: str = "dp") -> Dict[str, P]:
+    """Zero-style (FSDP) parameter sharding composed WITH tensor
+    parallelism: every weight matrix additionally shards a non-tp axis
+    over ``axis`` (conventionally dp), so parameter and optimizer-state
+    memory scale down with the dp degree.  XLA inserts the all-gathers
+    at use and reduce-scatters in the backward — the GSPMD formulation
+    of ZeRO-3; there is no wrapper class to write, only placement.
+
+    Optimizer state inherits the sharding automatically: optax init
+    builds moments with zeros_like over the placed params.
+    """
+    return {
+        "embed": P("tp", axis),
+        "pos_embed": P(),
+        "wq": P(axis, "tp", None),
+        "wk": P(axis, "tp", None),
+        "wv": P(axis, "tp", None),
+        "wo": P("tp", None, axis),
+        "w_in": P(axis, "tp"),
+        "w_out": P("tp", axis),
+        # MoE experts: expert axis over tp (as in the base rules), the
+        # feature axis over dp
+        "moe']['w_in": P("tp", axis, None),
+        "moe']['w_out": P("tp", axis, None),
+        "router": P(),
+        "lm_head": P(axis, "tp"),
+        "norm": P(),
+        "scale": P(),
+    }
+
+
 def transformer_activation_spec(use_sp: bool = True) -> P:
     """Sharding for the [batch, seq] token array."""
     return P("dp", "sp") if use_sp else P("dp", None)
