@@ -1,9 +1,12 @@
-"""Kubernetes cluster adapter (EXPERIMENTAL).
+"""Kubernetes cluster adapter.
 
 Maps the ClusterAPI surface onto the official ``kubernetes`` Python client
-(informer-style watches via watch streams).  The package is not bundled in
-this development image, so this adapter is import-gated and exercised only
-in real-cluster deployments; the FakeCluster covers all in-repo testing.
+(informer-style watches with resourceVersion resume, 410-Gone resync, and
+conflict-retried patches).  The package is not bundled in this development
+image, so the adapter is import-gated: in-repo tests drive it against the
+vendored API fake (`tests/fake_kubernetes.py`, `tests/test_k8s_adapter.py`),
+and `deploy/e2e-kind.sh` drives the same code path against a real kind API
+server on hosts with a container runtime.
 
 Only the fields the framework reads/writes are translated (see
 cluster.api.Pod/Node); everything else round-trips untouched because
