@@ -4,7 +4,15 @@ from .attention import (
     block_sparse_reference,
     flash_attention,
 )
-from .ring_attention import ring_attention, ring_attention_sharded
+from .ring_attention import (
+    ring_attention,
+    ring_attention_sharded,
+    ring_attention_zigzag,
+    ring_flash_attention_zigzag,
+    zigzag_positions,
+    zigzag_shard,
+    zigzag_unshard,
+)
 from .ulysses import ulysses_attention, ulysses_attention_sharded
 from .moe import MoEConfig, moe_apply, moe_init, moe_sharding_rules
 
@@ -15,6 +23,11 @@ __all__ = [
     "flash_attention",
     "ring_attention",
     "ring_attention_sharded",
+    "ring_attention_zigzag",
+    "ring_flash_attention_zigzag",
+    "zigzag_positions",
+    "zigzag_shard",
+    "zigzag_unshard",
     "ulysses_attention",
     "ulysses_attention_sharded",
     "MoEConfig",

@@ -47,30 +47,25 @@ def _block_pv(probs, v):
     return jnp.einsum("bngqk,bnkd->bngqd", p5, v).reshape(b, h, sq, d)
 
 
-def ring_attention(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    axis_name: str = "sp",
-    causal: bool = True,
-) -> jax.Array:
-    """Attention across the ring; call inside shard_map with the sequence
-    axis sharded over ``axis_name``."""
+def _ring_online_softmax(q, k, v, axis_name, causal, q_pos, k_pos_for_src):
+    """Shared online-softmax ring body: K/V rotate via ppermute while a
+    numerically-stable streaming softmax accumulates.  The sequence layout
+    is abstracted behind ``q_pos`` (this device's global query positions)
+    and ``k_pos_for_src(src)`` (global key positions of the shard that
+    started on ring position ``src``) — the contiguous and zigzag rings
+    differ only there."""
     axis_size = jax.lax.psum(1, axis_name)
     my_index = jax.lax.axis_index(axis_name)
-    b, h, s_local, d = q.shape
-    scale = d**-0.5
+    scale = q.shape[-1] ** -0.5
 
     # ppermute source->dest pairs: shift K/V one step around the ring
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
-
-    q_pos = my_index * s_local + jnp.arange(s_local)  # global query positions
 
     def accumulate(t, k_cur, v_cur, m, l, acc):
         src = (my_index - t) % axis_size  # ring position this K/V came from
         scores = _block_scores(q, k_cur, scale)  # [b,h,sq,sk] f32
         if causal:
-            k_pos = src * s_local + jnp.arange(s_local)
+            k_pos = k_pos_for_src(src)
             mask = q_pos[:, None] >= k_pos[None, :]
             scores = jnp.where(mask[None, None], scores, -jnp.inf)
         block_max = jnp.max(scores, axis=-1)  # [b,h,sq]
@@ -112,6 +107,24 @@ def ring_attention(
     _, l, acc = accumulate(axis_size - 1, k_last, v_last, m_last, l_last, acc_last)
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Attention across the ring; call inside shard_map with the sequence
+    axis sharded over ``axis_name``."""
+    my_index = jax.lax.axis_index(axis_name)
+    s_local = q.shape[2]
+    q_pos = my_index * s_local + jnp.arange(s_local)  # global query positions
+    return _ring_online_softmax(
+        q, k, v, axis_name, causal, q_pos,
+        lambda src: src * s_local + jnp.arange(s_local),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -246,6 +259,204 @@ def _ring_flash_bwd(axis_name, causal, interpret, residuals, g):
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
+# ---------------------------------------------------------------------------
+# Zigzag (load-balanced) causal ring.
+#
+# With contiguous shards the causal ring is imbalanced: at every step some
+# device computes a fully-visible block while others sit fully masked, and
+# each rotation synchronizes on the slowest — wall time ~ sp full blocks,
+# twice the useful causal work.  The zigzag layout gives device i chunks
+# i and 2*sp-1-i of a 2*sp-chunk split (one from each end).  Then for ANY
+# off-diagonal source exactly half of each device's 2x2 chunk-quadrant
+# grid is visible:
+#     src < my: both q chunks see k-low only   -> [2c x c] unmasked block
+#     src > my: q-high sees both k chunks      -> [c x 2c] unmasked block
+#     src == my: two diagonal-causal c x c blocks + one full c x c block
+# Every device does the same work at every step — the ring's causal wall
+# time halves — and every quadrant's mask stays STATIC (unmasked, causal,
+# or skipped), so the flash/einsum hybrid applies unchanged.
+# ---------------------------------------------------------------------------
+
+
+def zigzag_permutation(seq_len: int, sp: int):
+    """Global permutation placing the zigzag layout: ``perm[j]`` is the
+    source position of output slot ``j`` when the permuted sequence is
+    split contiguously over sp devices.  Chunk order per device: (i,
+    2*sp-1-i).  Returns (perm, inverse_perm) as numpy index arrays."""
+    import numpy as np
+
+    if seq_len % (2 * sp):
+        raise ValueError(f"seq_len {seq_len} not divisible by 2*sp={2 * sp}")
+    c = seq_len // (2 * sp)
+    chunks = []
+    for i in range(sp):
+        chunks.append(np.arange(i * c, (i + 1) * c))
+        j = 2 * sp - 1 - i
+        chunks.append(np.arange(j * c, (j + 1) * c))
+    perm = np.concatenate(chunks)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(seq_len)
+    return perm, inv
+
+
+def zigzag_shard(x: jax.Array, sp: int, axis: int = 2) -> jax.Array:
+    """Permute a contiguous global sequence axis into zigzag order (apply
+    OUTSIDE shard_map, before sequence-sharding over sp)."""
+    perm, _ = zigzag_permutation(x.shape[axis], sp)
+    return jnp.take(x, jnp.asarray(perm), axis=axis)
+
+
+def zigzag_unshard(x: jax.Array, sp: int, axis: int = 2) -> jax.Array:
+    """Inverse of :func:`zigzag_shard`."""
+    _, inv = zigzag_permutation(x.shape[axis], sp)
+    return jnp.take(x, jnp.asarray(inv), axis=axis)
+
+
+def zigzag_positions(axis_name: str, s_local: int) -> jax.Array:
+    """Global token positions of this device's zigzag shard (e.g. for
+    RoPE inside a zigzag-sharded stage).  ``s_local`` is the local
+    (two-chunk) length."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_index = jax.lax.axis_index(axis_name)
+    c = s_local // 2
+    low = my_index * c + jnp.arange(c)
+    high = (2 * axis_size - 1 - my_index) * c + jnp.arange(c)
+    return jnp.concatenate([low, high])
+
+
+def ring_attention_zigzag(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Load-balanced causal ring attention over zigzag-ordered shards
+    (see :func:`zigzag_shard`).  Call inside shard_map; each device's
+    local sequence is its two chunks concatenated.  Non-causal callers
+    should use :func:`ring_attention` (zigzag only helps causal)."""
+    axis_size = jax.lax.psum(1, axis_name)
+    s_local = q.shape[2]
+    if s_local % 2:
+        raise ValueError(f"zigzag shard length must be even, got {s_local}")
+    c = s_local // 2
+
+    def k_pos_for_src(src):
+        return jnp.concatenate([
+            src * c + jnp.arange(c),
+            (2 * axis_size - 1 - src) * c + jnp.arange(c),
+        ])
+
+    return _ring_online_softmax(
+        q, k, v, axis_name, causal,
+        zigzag_positions(axis_name, s_local), k_pos_for_src,
+    )
+
+
+def _zigzag_hybrid_forward(q, k, v, axis_name, interpret):
+    """Causal zigzag ring with per-quadrant static-mask partials: each
+    off-diagonal step computes ONE unmasked half block ([2c x c] for
+    earlier sources, [c x 2c] for later); the diagonal step runs the
+    causal flash kernel on the two diagonal quadrants plus one full
+    block.  Work per device per step is constant — the balanced ring."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_index = jax.lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    if s_local % 2:
+        raise ValueError(f"zigzag shard length must be even, got {s_local}")
+    c = s_local // 2
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    neg_inf_lse = jnp.full((b, h, c), -jnp.inf, jnp.float32)
+    zeros_half = jnp.zeros((b, h, c, d), jnp.float32)
+
+    def earlier(k_cur, v_cur):
+        # src < my: both q chunks attend k-low, k-high fully masked
+        out, lse = _partial_einsum(q, k_cur[:, :, :c], v_cur[:, :, :c], False)
+        return out, lse
+
+    def later(k_cur, v_cur):
+        # src > my: q-high attends both k chunks, q-low fully masked
+        out_hi, lse_hi = _partial_einsum(
+            q[:, :, c:], k_cur, v_cur, False)
+        out = jnp.concatenate([zeros_half, out_hi], axis=2)
+        lse = jnp.concatenate([neg_inf_lse, lse_hi], axis=2)
+        return out, lse
+
+    def diagonal(k_cur, v_cur):
+        # q-low x k-low and q-high x k-high: causal within the chunk;
+        # q-high x k-low: fully visible
+        out_ll, lse_ll = _partial_flash(
+            q[:, :, :c], k_cur[:, :, :c], v_cur[:, :, :c], True, interpret)
+        out_hh, lse_hh = _partial_flash(
+            q[:, :, c:], k_cur[:, :, c:], v_cur[:, :, c:], True, interpret)
+        out_hl, lse_hl = _partial_einsum(
+            q[:, :, c:], k_cur[:, :, :c], v_cur[:, :, :c], False)
+        out_hi, lse_hi = _merge_partials(out_hh, lse_hh, out_hl, lse_hl)
+        out = jnp.concatenate([out_ll, out_hi], axis=2)
+        lse = jnp.concatenate([lse_ll, lse_hi], axis=2)
+        return out, lse
+
+    def block_partial(t, k_cur, v_cur):
+        src = (my_index - t) % axis_size
+        branch = jnp.where(src == my_index, 2,
+                           jnp.where(src < my_index, 0, 1))
+        return jax.lax.switch(branch, (earlier, later, diagonal),
+                              k_cur, v_cur)
+
+    def step(t, carry):
+        k_cur, v_cur, out, lse = carry
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        out_blk, lse_blk = block_partial(t, k_cur, v_cur)
+        out, lse = _merge_partials(out, lse, out_blk, lse_blk)
+        return k_next, v_next, out, lse
+
+    out0 = (q * 0).astype(jnp.float32)
+    lse0 = out0[..., 0] - jnp.inf
+    k_last, v_last, out, lse = jax.lax.fori_loop(
+        0, axis_size - 1, step, (k, v, out0, lse0)
+    )
+    out_blk, lse_blk = block_partial(axis_size - 1, k_last, v_last)
+    out, _ = _merge_partials(out, lse, out_blk, lse_blk)
+    return out.astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _zigzag_hybrid(q, k, v, axis_name, interpret):
+    return _zigzag_hybrid_forward(q, k, v, axis_name, interpret)
+
+
+def _zigzag_hybrid_fwd(q, k, v, axis_name, interpret):
+    return _zigzag_hybrid_forward(q, k, v, axis_name, interpret), (q, k, v)
+
+
+def _zigzag_hybrid_bwd(axis_name, interpret, residuals, g):
+    # exact grads by differentiating the einsum zigzag ring (same math)
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q, k, v: ring_attention_zigzag(q, k, v, axis_name, True),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_zigzag_hybrid.defvjp(_zigzag_hybrid_fwd, _zigzag_hybrid_bwd)
+
+
+def ring_flash_attention_zigzag(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    interpret: bool = False,
+) -> jax.Array:
+    """The balanced causal ring with hybrid flash/einsum partials (see
+    :func:`_zigzag_hybrid_forward`).  Causal only; call inside shard_map
+    over zigzag-ordered shards."""
+    return _zigzag_hybrid(q, k, v, axis_name, interpret)
+
+
 def ring_flash_auto(
     seq_len: int, mesh: Mesh, seq_axis: str, interpret: bool
 ) -> bool:
@@ -285,6 +496,7 @@ def ring_attention_sharded(
     head_axis: Optional[str] = "tp",
     use_flash: Optional[bool] = None,
     interpret: bool = False,
+    layout: str = "contiguous",
 ) -> jax.Array:
     """shard_map wrapper: [batch, heads, seq, head_dim] with batch over dp,
     heads over tp, and sequence over sp.
@@ -293,11 +505,35 @@ def ring_attention_sharded(
     the diagonal step, einsum partials on fully-visible steps) on TPU when
     the per-device sequence shard is long enough for the kernel to win
     (matching flash_attention's threshold); ``interpret=True`` forces the
-    kernel path in interpret mode for CPU tests."""
+    kernel path in interpret mode for CPU tests.
+
+    ``layout="zigzag"`` (causal only) runs the load-balanced ring: inputs
+    are permuted into zigzag order, sharded, attended with the balanced
+    per-step partials, and the output permuted back — callers see plain
+    contiguous sequences.  Long-lived zigzag pipelines should instead keep
+    activations zigzag-ordered across layers (permute once at embedding
+    with :func:`zigzag_shard`, use :func:`zigzag_positions` for RoPE) and
+    call the in-shard entry points directly."""
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown ring layout {layout!r}")
+    if layout == "zigzag" and not causal:
+        raise ValueError("zigzag layout only balances causal attention")
     if use_flash is None:
-        use_flash = ring_flash_auto(q.shape[2], mesh, seq_axis, interpret)
+        # the zigzag kernel only ever runs on half-shard (c x c) diagonal
+        # quadrants, so its win threshold applies to half the shard
+        auto_len = q.shape[2] // 2 if layout == "zigzag" else q.shape[2]
+        use_flash = ring_flash_auto(auto_len, mesh, seq_axis, interpret)
     spec = P(batch_axis, head_axis, seq_axis, None)
-    if use_flash:
+    sp = mesh.shape[seq_axis]
+    if layout == "zigzag":
+        q, k, v = (zigzag_shard(x, sp) for x in (q, k, v))
+        if use_flash:
+            fn = functools.partial(ring_flash_attention_zigzag,
+                                   axis_name=seq_axis, interpret=interpret)
+        else:
+            fn = functools.partial(ring_attention_zigzag,
+                                   axis_name=seq_axis, causal=True)
+    elif use_flash:
         fn = functools.partial(
             ring_flash_attention, axis_name=seq_axis, causal=causal,
             interpret=interpret,
@@ -307,7 +543,10 @@ def ring_attention_sharded(
     # interpret-mode pallas evaluation mixes varying and invariant operands
     # in its block slicing, which the vma checker rejects; the compiled TPU
     # kernel (and the einsum path) keep full checking
-    return jax.shard_map(
+    out = jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=not (use_flash and interpret),
     )(q, k, v)
+    if layout == "zigzag":
+        out = zigzag_unshard(out, sp)
+    return out
