@@ -128,8 +128,14 @@ def _select_attention(config: TransformerConfig):
                                                window=window)
 
 
-def _forward(params, tokens, config, attention_fn, pos_offset):
-    """Shared forward body; pos_offset supports sequence-sharded callers."""
+def _forward(params, tokens, config, attention_fn, pos_offset,
+             apply_head: bool = True):
+    """Shared forward body.  ``pos_offset`` supports sequence-sharded
+    callers: a scalar offset for contiguous shards, or a [seq] array of
+    global token positions for permuted layouts (the zigzag ring).
+    ``apply_head=False`` returns the final-normed hidden states instead
+    of logits (permuted-layout callers un-permute at hidden width and
+    project outside — the logits would be vocab/d_model times wider)."""
     dtype = config.dtype
     seq = tokens.shape[1]
     x = params["embed"][tokens].astype(dtype)
@@ -138,8 +144,12 @@ def _forward(params, tokens, config, attention_fn, pos_offset):
             f"positional must be 'learned' or 'rope', got {config.positional!r}"
         )
     use_rope = config.positional == "rope"
+    explicit_positions = jnp.ndim(pos_offset) == 1
     if use_rope:
-        positions = rope_positions(seq, pos_offset)
+        positions = (pos_offset if explicit_positions
+                     else rope_positions(seq, pos_offset))
+    elif explicit_positions:
+        x = x + params["pos_embed"][pos_offset].astype(dtype)
     else:
         pos = jax.lax.dynamic_slice_in_dim(params["pos_embed"], pos_offset, seq)
         x = x + pos.astype(dtype)
@@ -159,6 +169,8 @@ def _forward(params, tokens, config, attention_fn, pos_offset):
         aux_total = aux_total + aux
 
     x = _rms_norm(x, params["final_norm"]["scale"])
+    if not apply_head:
+        return x, aux_total
     return (x @ params["lm_head"].astype(dtype)).astype(jnp.float32), aux_total
 
 
@@ -266,35 +278,71 @@ def transformer_apply_ring(
     seq_axis: str = "sp",
     use_flash: Optional[bool] = None,
     interpret: bool = False,
+    layout: str = "contiguous",
 ) -> jax.Array:
     """Sequence-parallel forward: tokens sharded over ``seq_axis``, ring
     attention carrying K/V around the ICI ring (long-context path).
 
     ``use_flash=None`` auto-selects the Pallas-fused ring body on TPU when
     the per-device sequence shard reaches the kernel threshold (the kernel
-    win then compounds with sp — exactly where sequences are longest)."""
+    win then compounds with sp — exactly where sequences are longest).
+
+    ``layout="zigzag"`` runs the load-balanced causal ring end to end:
+    tokens are permuted into zigzag order once, every layer attends with
+    the balanced per-step partials (RoPE/learned positions follow the
+    permuted global positions), and the logits are permuted back —
+    callers see contiguous sequences."""
+    from ..ops.ring_attention import (
+        ring_attention_zigzag,
+        ring_flash_attention_zigzag,
+        zigzag_positions,
+        zigzag_shard,
+        zigzag_unshard,
+    )
 
     _validate_sp_entry("ring", config, mesh, seq_axis)
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown ring layout {layout!r}")
+    zigzag = layout == "zigzag"
+    sp = mesh.shape[seq_axis]
     if use_flash is None:
         from ..ops.ring_attention import ring_flash_auto
 
-        use_flash = ring_flash_auto(tokens.shape[1], mesh, seq_axis, interpret)
+        auto_len = tokens.shape[1] // 2 if zigzag else tokens.shape[1]
+        use_flash = ring_flash_auto(auto_len, mesh, seq_axis, interpret)
+    if zigzag:
+        tokens = zigzag_shard(tokens, sp, axis=1)
 
     def local_forward(params, tokens):
         local_seq = tokens.shape[1]
-        offset = jax.lax.axis_index(seq_axis) * local_seq
-        if use_flash:
-            attention_fn = lambda q, k, v: ring_flash_attention(
-                q, k, v, axis_name=seq_axis, causal=True, interpret=interpret
-            )
+        if zigzag:
+            pos = zigzag_positions(seq_axis, local_seq)
+            if use_flash:
+                attention_fn = lambda q, k, v: ring_flash_attention_zigzag(
+                    q, k, v, axis_name=seq_axis, interpret=interpret
+                )
+            else:
+                attention_fn = lambda q, k, v: ring_attention_zigzag(
+                    q, k, v, axis_name=seq_axis, causal=True
+                )
         else:
-            attention_fn = lambda q, k, v: ring_attention(
-                q, k, v, axis_name=seq_axis, causal=True
-            )
-        logits, _ = _forward(params, tokens, config, attention_fn, offset)
-        return logits
+            pos = jax.lax.axis_index(seq_axis) * local_seq
+            if use_flash:
+                attention_fn = lambda q, k, v: ring_flash_attention(
+                    q, k, v, axis_name=seq_axis, causal=True,
+                    interpret=interpret
+                )
+            else:
+                attention_fn = lambda q, k, v: ring_attention(
+                    q, k, v, axis_name=seq_axis, causal=True
+                )
+        # zigzag: return hidden states and project outside — the inverse
+        # permutation then moves d_model-wide rows, not vocab-wide logits
+        out, _ = _forward(params, tokens, config, attention_fn, pos,
+                          apply_head=not zigzag)
+        return out
 
-    return jax.shard_map(
+    out = jax.shard_map(
         local_forward,
         mesh=mesh,
         in_specs=(P(), P(batch_axis, seq_axis)),
@@ -304,6 +352,11 @@ def transformer_apply_ring(
         # kernel path keeps full checking over the whole forward
         check_vma=not (use_flash and interpret),
     )(params, tokens)
+    if zigzag:
+        hidden = zigzag_unshard(out, sp, axis=1)
+        out = (hidden @ params["lm_head"].astype(config.dtype)).astype(
+            jnp.float32)
+    return out
 
 
 def transformer_apply_ulysses(

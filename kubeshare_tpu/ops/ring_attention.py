@@ -458,14 +458,19 @@ def ring_flash_attention_zigzag(
 
 
 def ring_flash_auto(
-    seq_len: int, mesh: Mesh, seq_axis: str, interpret: bool
+    seq_len: int, mesh: Mesh, seq_axis: str, interpret: bool,
+    layout: str = "contiguous",
 ) -> bool:
     """One source of truth for every ring entry point's flash auto-select:
     the Pallas-fused body when the per-device shard reaches the kernel's
-    win threshold on this mesh's platform (or interpret forces it)."""
+    win threshold on this mesh's platform (or interpret forces it).  The
+    zigzag layout's kernel only ever runs on half-shard (c x c) diagonal
+    quadrants, so its threshold applies to half the shard."""
     from .attention import use_pallas_default
 
     s_local = seq_len // mesh.shape[seq_axis]
+    if layout == "zigzag":
+        s_local //= 2
     return use_pallas_default(mesh.devices.flat[0].platform, s_local, interpret)
 
 
@@ -519,10 +524,8 @@ def ring_attention_sharded(
     if layout == "zigzag" and not causal:
         raise ValueError("zigzag layout only balances causal attention")
     if use_flash is None:
-        # the zigzag kernel only ever runs on half-shard (c x c) diagonal
-        # quadrants, so its win threshold applies to half the shard
-        auto_len = q.shape[2] // 2 if layout == "zigzag" else q.shape[2]
-        use_flash = ring_flash_auto(auto_len, mesh, seq_axis, interpret)
+        use_flash = ring_flash_auto(q.shape[2], mesh, seq_axis, interpret,
+                                    layout=layout)
     spec = P(batch_axis, head_axis, seq_axis, None)
     sp = mesh.shape[seq_axis]
     if layout == "zigzag":

@@ -519,6 +519,45 @@ class TestRingTransformer:
         np.testing.assert_allclose(np.asarray(dense), np.asarray(ring),
                                    rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.parametrize("positional", ["rope", "learned"])
+    def test_zigzag_ring_forward_matches_dense(self, positional):
+        """End-to-end zigzag: tokens permuted once, every layer attends
+        with the balanced ring and positions follow the permutation
+        (RoPE and the learned table), logits permuted back."""
+        from kubeshare_tpu.models.transformer import transformer_apply_ring
+
+        mesh = make_mesh(MeshSpec(dp=2, tp=1, sp=4))
+        config = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq_len=64, dtype=jnp.float32, attention="reference",
+            positional=positional,
+        )
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 64)
+        dense = transformer_apply(params, tokens, config)
+        ring = transformer_apply_ring(params, tokens, config, mesh,
+                                      layout="zigzag", use_flash=False)
+        np.testing.assert_allclose(np.asarray(dense), np.asarray(ring),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_zigzag_ring_flash_forward_matches_dense(self):
+        from kubeshare_tpu.models.transformer import transformer_apply_ring
+
+        mesh = make_mesh(MeshSpec(dp=2, tp=1, sp=4))
+        config = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq_len=64, dtype=jnp.float32, attention="reference",
+            positional="rope",
+        )
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 64)
+        dense = transformer_apply(params, tokens, config)
+        ring = transformer_apply_ring(params, tokens, config, mesh,
+                                      layout="zigzag", use_flash=True,
+                                      interpret=True)
+        np.testing.assert_allclose(np.asarray(dense), np.asarray(ring),
+                                   rtol=2e-4, atol=2e-4)
+
     def test_ring_config_on_dense_entry_raises(self):
         config = TransformerConfig(attention="ring")
         params_cfg = TransformerConfig(
