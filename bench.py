@@ -540,6 +540,17 @@ def main() -> None:
                 "limit_clamped": greedy_duty <= 0.5 + 0.05,
                 "floor_held": victim_retention >= 0.90,
             }
+            if adv_phase.platform == "cpu":
+                # on a single serial core the victim's host-side Python
+                # contends with the greedy's regardless of token
+                # arbitration (docs/perf.md CPU-fallback policy); the
+                # floor criterion presumes chip compute overlapping host
+                # work, so only the clamp is meaningful here
+                adversarial["platform_note"] = (
+                    "cpu fallback: floor_held reflects serial-core host "
+                    "contention, not token-runtime isolation; "
+                    "limit_clamped is the meaningful signal"
+                )
         except WorkerFailure as adv_failure:
             # the cooperative capture must survive an adversarial-phase
             # hiccup; record why the proof is missing instead of dying

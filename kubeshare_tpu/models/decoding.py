@@ -87,6 +87,15 @@ def _decode_one(params, config: TransformerConfig, cache: Dict, token: jax.Array
             # stays tiny.
             from ..ops.moe import MoEConfig, moe_apply
 
+            if config.moe_routing == "experts_choose":
+                raise ValueError(
+                    "expert-choice routing cannot be replayed token-by-"
+                    "token (an expert's choices depend on the whole "
+                    "sequence); decode requires moe_routing='tokens_choose'"
+                )
+            if config.moe_routing != "tokens_choose":
+                raise ValueError(
+                    f"unknown moe_routing {config.moe_routing!r}")
             e, d_m, f = layer["moe"]["w_in"].shape
             out, _ = moe_apply(
                 layer["moe"], y,

@@ -29,6 +29,13 @@ class MoEConfig:
     # routing fan-out: 1 = Switch (gate is the raw top prob), >1 = GShard
     # style (gates renormalized over the chosen experts)
     top_k: int = 1
+    # "tokens_choose": classic top-k routing (above).  "experts_choose":
+    # expert-choice routing (Zhou et al. 2022) — each expert takes its
+    # top-capacity tokens, so load is perfectly balanced by construction
+    # and nothing is ever dropped; training-time only for causal LMs (an
+    # expert's choices depend on the whole batch/sequence, so it cannot
+    # be replayed token-by-token at decode)
+    routing: str = "tokens_choose"
 
 
 def moe_init(rng: jax.Array, config: MoEConfig) -> Dict:
@@ -66,15 +73,23 @@ def moe_apply(
     k = config.top_k
     if not 1 <= k <= e:
         raise ValueError(f"top_k must be in [1, num_experts], got {k}")
+    if config.routing not in ("tokens_choose", "experts_choose"):
+        raise ValueError(f"unknown routing {config.routing!r}")
     tokens = x.reshape(b * s, d)
     n = tokens.shape[0]
     if capacity is None:
-        capacity = max(1, math.ceil(config.capacity_factor * k * n / e))
+        # top_k is a tokens_choose fan-out; expert-choice capacity follows
+        # the cf*n/e convention regardless of it
+        fanout = k if config.routing == "tokens_choose" else 1
+        capacity = max(1, math.ceil(config.capacity_factor * fanout * n / e))
     elif capacity < 1:
         raise ValueError(f"capacity must be >= 1, got {capacity}")
 
     logits = tokens @ params["router"]  # [n, e]
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    if config.routing == "experts_choose":
+        return _experts_choose(params, x, tokens, probs, config,
+                               min(capacity, n))
     topk_gate, topk_index = jax.lax.top_k(probs, k)  # [n, k]
     if k > 1:
         topk_gate = topk_gate / jnp.sum(topk_gate, axis=-1, keepdims=True)
@@ -102,14 +117,8 @@ def moe_apply(
         "kn,knec->nec", topk_gate.T.astype(x.dtype), dispatch_k
     )
 
-    expert_inputs = jnp.einsum("nec,nd->ecd", dispatch, tokens)  # [e, cap, d]
-    hidden = jax.nn.gelu(
-        jnp.einsum("ecd,edf->ecf", expert_inputs, params["w_in"].astype(x.dtype))
-    )
-    expert_outputs = jnp.einsum(
-        "ecf,efd->ecd", hidden, params["w_out"].astype(x.dtype)
-    )
-    combined = jnp.einsum("nec,ecd->nd", combine, expert_outputs)
+    combined = _dispatch_experts_combine(params, tokens, dispatch, combine,
+                                         x.dtype)
 
     # load-balancing auxiliary loss over first choices (Switch/GShard style)
     assignment_fraction = jnp.mean(onehot[:, 0, :].astype(jnp.float32), axis=0)
@@ -117,6 +126,47 @@ def moe_apply(
     aux_loss = jnp.sum(assignment_fraction * mean_probs) * e
 
     return combined.reshape(b, s, d), aux_loss
+
+
+def _dispatch_experts_combine(params, tokens, dispatch, combine, dtype):
+    """Shared expert-FFN body: gather token buffers per expert
+    ([n, e, cap] dispatch), run every expert's MLP, and weight results
+    back per token ([n, e, cap] combine).  Both routing families differ
+    only in how dispatch/combine are built."""
+    expert_inputs = jnp.einsum("nec,nd->ecd", dispatch, tokens)  # [e, cap, d]
+    hidden = jax.nn.gelu(
+        jnp.einsum("ecd,edf->ecf", expert_inputs, params["w_in"].astype(dtype))
+    )
+    expert_outputs = jnp.einsum(
+        "ecf,efd->ecd", hidden, params["w_out"].astype(dtype)
+    )
+    return jnp.einsum("nec,ecd->nd", combine, expert_outputs)
+
+
+def _experts_choose(params, x, tokens, probs, config, capacity):
+    """Expert-choice routing: every expert selects its ``capacity``
+    highest-affinity tokens — load is balanced by construction, no token
+    dropping, no load-balancing aux loss needed (returned aux is 0).  A
+    token may be picked by several experts (outputs sum, gated by the
+    picking expert's affinity) or by none (output 0, like a dropped
+    token in top-k routing — the residual connection carries it)."""
+    b, s, d = x.shape
+    e = config.num_experts
+    n = tokens.shape[0]
+
+    gates, picks = jax.lax.top_k(probs.T, capacity)  # [e, capacity]
+    # dense dispatch [n, e, capacity]: slot c of expert j holds token
+    # picks[j, c]
+    dispatch = (
+        jax.nn.one_hot(picks, n, dtype=jnp.int32)  # [e, cap, n]
+        .transpose(2, 0, 1)
+        .astype(x.dtype)
+    )
+    combine = dispatch * gates.astype(x.dtype)[None, :, :]
+
+    combined = _dispatch_experts_combine(params, tokens, dispatch, combine,
+                                         x.dtype)
+    return combined.reshape(b, s, d), jnp.float32(0.0)
 
 
 def moe_sharding_rules(ep_axis: str = "dp") -> Dict[str, P]:

@@ -1419,6 +1419,28 @@ class TestMoEFlagship:
             np.asarray(dense[:, -1]), np.asarray(last_logits),
             rtol=2e-4, atol=2e-4)
 
+    def test_experts_choose_flagship_trains_but_refuses_decode(self):
+        """moe_routing='experts_choose': training works (grads finite,
+        zero aux), incremental decode raises — expert choices depend on
+        the whole sequence and cannot be replayed token-by-token."""
+        from kubeshare_tpu.models.decoding import prefill
+
+        config = self._config(moe_routing="experts_choose",
+                              moe_capacity_factor=2.0)
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        tokens = jax.random.randint(jax.random.PRNGKey(9), (2, 12), 0, 64)
+        logits, aux = transformer_apply_with_aux(params, tokens, config)
+        assert np.isfinite(np.asarray(logits)).all()
+        assert float(aux) == 0.0
+
+        grads = jax.grad(lambda p: cross_entropy_loss(
+            transformer_apply(p, tokens, config), tokens))(params)
+        g = np.asarray(grads["layers"][1]["moe"]["w_in"])
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+        with pytest.raises(ValueError, match="expert-choice"):
+            prefill(params, config, tokens)
+
     def test_decode_batch_independent_at_default_capacity(self):
         """Batched incremental decode must equal per-row decode even at the
         default capacity_factor (1.25): the decode path pins capacity to the

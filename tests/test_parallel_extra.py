@@ -116,6 +116,56 @@ class TestMoE:
         with pytest.raises(ValueError, match="top_k"):
             moe_apply(params, x, config)
 
+    def test_experts_choose_full_capacity_is_soft_mixture(self):
+        """Expert-choice at capacity=n: every expert picks every token
+        (gated by its affinity), so the output equals the dense softmax-
+        weighted mixture over ALL experts — a closed-form reference."""
+        config = MoEConfig(d_model=16, d_ff=32, num_experts=4,
+                           routing="experts_choose")
+        params = moe_init(jax.random.PRNGKey(0), config)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16))
+        out, aux = moe_apply(params, x, config, capacity=16)
+        assert float(aux) == 0.0  # balanced by construction: no aux loss
+
+        tokens = x.reshape(16, 16)
+        probs = jax.nn.softmax(tokens @ params["router"], axis=-1)
+        hidden = jax.nn.gelu(
+            jnp.einsum("nd,edf->enf", tokens, params["w_in"]))
+        outs = jnp.einsum("enf,efd->end", hidden, params["w_out"])
+        expected = jnp.einsum("ne,end->nd", probs, outs).reshape(x.shape)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_experts_choose_load_balanced_by_construction(self):
+        # capacity 2 with 2 experts: at most 4 token-slots filled, and no
+        # expert ever exceeds its capacity regardless of router skew
+        config = MoEConfig(d_model=8, d_ff=16, num_experts=2,
+                           routing="experts_choose")
+        params = dict(moe_init(jax.random.PRNGKey(0), config))
+        params["router"] = jnp.array([[5.0, -5.0]] * 8)  # heavy skew
+        x = jnp.abs(jax.random.normal(jax.random.PRNGKey(1), (1, 8, 8))) + 0.1
+        out, _ = moe_apply(params, x, config, capacity=2)
+        touched = np.any(np.asarray(out[0]) != 0.0, axis=-1)
+        assert 2 <= touched.sum() <= 4
+
+    def test_experts_choose_grads_reach_every_expert(self):
+        config = MoEConfig(d_model=8, d_ff=16, num_experts=4,
+                           capacity_factor=2.0, routing="experts_choose")
+        params = moe_init(jax.random.PRNGKey(0), config)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 8))
+        grads = jax.grad(
+            lambda p: jnp.mean(moe_apply(p, x, config)[0] ** 2)
+        )(params)
+        g_in = np.asarray(grads["w_in"])
+        assert (np.abs(g_in).sum(axis=(1, 2)) > 0).all()
+
+    def test_unknown_routing_rejected(self):
+        config = MoEConfig(d_model=8, d_ff=16, num_experts=2,
+                           routing="coin_flip")
+        params = moe_init(jax.random.PRNGKey(0), config)
+        with pytest.raises(ValueError, match="routing"):
+            moe_apply(params, jnp.zeros((1, 2, 8)), config)
+
     def test_expert_parallel_training(self):
         mesh = make_mesh(MeshSpec(dp=4, tp=2, sp=1))
         config = MoEConfig(d_model=16, d_ff=32, num_experts=4)
