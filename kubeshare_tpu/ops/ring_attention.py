@@ -109,6 +109,13 @@ def _ring_online_softmax(q, k, v, axis_name, causal, q_pos, k_pos_for_src):
     return out.astype(q.dtype)
 
 
+def _contiguous_positions(index, s_local):
+    """Global token positions of a contiguous shard at ring position
+    ``index`` — the one place the contiguous layout's invariant lives
+    (forward masks and the hand-scheduled backward both use it)."""
+    return index * s_local + jnp.arange(s_local)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -120,10 +127,10 @@ def ring_attention(
     axis sharded over ``axis_name``."""
     my_index = jax.lax.axis_index(axis_name)
     s_local = q.shape[2]
-    q_pos = my_index * s_local + jnp.arange(s_local)  # global query positions
     return _ring_online_softmax(
-        q, k, v, axis_name, causal, q_pos,
-        lambda src: src * s_local + jnp.arange(s_local),
+        q, k, v, axis_name, causal,
+        _contiguous_positions(my_index, s_local),
+        lambda src: _contiguous_positions(src, s_local),
     )
 
 
@@ -231,29 +238,128 @@ def _ring_flash_forward(q, k, v, axis_name, causal, interpret):
         0, axis_size - 1, step, (k, v, out0, lse0)
     )
     out_blk, lse_blk = block_partial(axis_size - 1, k_last, v_last)
-    out, _ = _merge_partials(out, lse, out_blk, lse_blk)
-    return out.astype(q.dtype)
+    out, lse = _merge_partials(out, lse, out_blk, lse_blk)
+    return out.astype(q.dtype), lse
+
+
+def _ring_backward(q, k, v, out, lse, g, axis_name, causal, q_pos,
+                   k_pos_for_src, masked_for_src=None):
+    """Hand-scheduled ring backward from saved forward residuals.
+
+    The autodiff alternative replays the whole forward ring and
+    differentiates it (~3x forward FLOPs).  With ``out``/``lse`` saved,
+    each step needs only the standard flash backward block math —
+    p = exp(scores - lse), dv += p^T g, ds = p*(g v^T - delta),
+    dq += ds k, dk += ds^T q — about 2x forward FLOPs.  dK/dV partials
+    rotate WITH their K/V blocks, so after the full loop each lands back
+    on its home device; exactly one ppermute chain per tensor, all ICI
+    neighbor traffic.  Layout-agnostic via the same position callbacks as
+    the forward (contiguous and zigzag both route here).
+
+    ``masked_for_src(src)`` (bool scalar) marks steps whose block is
+    FULLY masked on this device — their contribution is exactly zero, so
+    the block math is skipped under lax.cond (mirrors the forward's
+    static 'masked' switch branch; halves the contiguous causal
+    backward)."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_index = jax.lax.axis_index(axis_name)
+    b, h, sq, d = q.shape
+    h_kv = k.shape[1]
+    group = h // h_kv
+    scale = d**-0.5
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    g32 = g.astype(jnp.float32)
+    delta = jnp.sum(g32 * out.astype(jnp.float32), axis=-1)  # [b,h,sq]
+
+    def sum_heads_to_kv(x):
+        # [b, h, sk, d] -> [b, h_kv, sk, d]: query-head groups sum onto
+        # their shared KV head
+        if group == 1:
+            return x
+        return x.reshape(b, h_kv, group, *x.shape[2:]).sum(axis=2)
+
+    def block_math(args):
+        src, k_cur, v_cur, dk_cur, dv_cur, dq = args
+        scores = _block_scores(q, k_cur, scale)  # [b,h,sq,sk] f32
+        if causal:
+            mask = q_pos[:, None] >= k_pos_for_src(src)[None, :]
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        # lse is the GLOBAL logsumexp from the forward: p is each block's
+        # final (fully-normalized) probability slice
+        p = jnp.exp(scores - lse[..., None])
+        p = jnp.where(jnp.isfinite(scores), p, 0.0)
+
+        # dv += p^T g  (grouped onto KV heads)
+        dv_cur = dv_cur + sum_heads_to_kv(
+            jnp.einsum("bhqk,bhqd->bhkd", p, g32))
+        # dp = g v^T -> ds = p * (dp - delta) * scale
+        dp = _block_scores(g32, v_cur.astype(jnp.float32), 1.0)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + _block_pv(ds, k_cur.astype(jnp.float32))
+        dk_cur = dk_cur + sum_heads_to_kv(
+            jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32)))
+        return dk_cur, dv_cur, dq
+
+    def step_math(t, k_cur, v_cur, dk_cur, dv_cur, dq):
+        src = (my_index - t) % axis_size
+        args = (src, k_cur, v_cur, dk_cur, dv_cur, dq)
+        if masked_for_src is None:
+            return block_math(args)
+        return jax.lax.cond(
+            masked_for_src(src),
+            lambda a: (a[3], a[4], a[5]),  # fully masked: zero contribution
+            block_math,
+            args,
+        )
+
+    def step(t, carry):
+        k_cur, v_cur, dk_cur, dv_cur, dq = carry
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_cur, dv_cur, dq = step_math(t, k_cur, v_cur, dk_cur, dv_cur, dq)
+        dk_next = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_next = jax.lax.ppermute(dv_cur, axis_name, perm)
+        return k_next, v_next, dk_next, dv_next, dq
+
+    varying = (jax.lax.axis_index(axis_name) * 0).astype(jnp.float32)
+    dq0 = jnp.zeros(q.shape, jnp.float32) + varying
+    dk0 = jnp.zeros(k.shape, jnp.float32) + varying
+    dv0 = jnp.zeros(v.shape, jnp.float32) + varying
+    # blocks 0..axis_size-2 in the loop; the final block is peeled so its
+    # dead K/V rotation is never issued (the dk/dv partials still need
+    # their last homing hop)
+    k_last, v_last, dk, dv, dq = jax.lax.fori_loop(
+        0, axis_size - 1, step, (k, v, dk0, dv0, dq0)
+    )
+    dk, dv, dq = step_math(axis_size - 1, k_last, v_last, dk, dv, dq)
+    dk = jax.lax.ppermute(dk, axis_name, perm)
+    dv = jax.lax.ppermute(dv, axis_name, perm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _ring_flash(q, k, v, axis_name, causal, interpret):
-    return _ring_flash_forward(q, k, v, axis_name, causal, interpret)
+    return _ring_flash_forward(q, k, v, axis_name, causal, interpret)[0]
 
 
 def _ring_flash_fwd(q, k, v, axis_name, causal, interpret):
-    return _ring_flash_forward(q, k, v, axis_name, causal, interpret), (q, k, v)
+    out, lse = _ring_flash_forward(q, k, v, axis_name, causal, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _ring_flash_bwd(axis_name, causal, interpret, residuals, g):
-    # backward recomputes the einsum ring and differentiates it — exact
-    # gradients (same math), flash-kernel speed kept on the forward; a
-    # fully kernelized ring backward (second ring pass over dk/dv/dq
-    # blocks) is the natural next step
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q, k, v: ring_attention(q, k, v, axis_name, causal), q, k, v
+    q, k, v, out, lse = residuals
+    my_index = jax.lax.axis_index(axis_name)
+    s_local = q.shape[2]
+    return _ring_backward(
+        q, k, v, out, lse, g, axis_name, causal,
+        _contiguous_positions(my_index, s_local),
+        lambda src: _contiguous_positions(src, s_local),
+        # contiguous causal: blocks from later ring positions are fully
+        # masked — skip their block math like the forward does
+        masked_for_src=(lambda src: src > my_index) if causal else None,
     )
-    return vjp(g)
 
 
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
@@ -312,16 +418,25 @@ def zigzag_unshard(x: jax.Array, sp: int, axis: int = 2) -> jax.Array:
     return jnp.take(x, jnp.asarray(inv), axis=axis)
 
 
+def _zigzag_shard_positions(index, axis_size, c):
+    """Global token positions of the zigzag shard at ring position
+    ``index`` (chunks ``index`` and ``2*axis_size-1-index``, each length
+    ``c``) — the one place the zigzag layout's invariant lives (forward
+    masks, the hand-scheduled backward, and RoPE all use it)."""
+    low = index * c + jnp.arange(c)
+    high = (2 * axis_size - 1 - index) * c + jnp.arange(c)
+    return jnp.concatenate([low, high])
+
+
 def zigzag_positions(axis_name: str, s_local: int) -> jax.Array:
     """Global token positions of this device's zigzag shard (e.g. for
     RoPE inside a zigzag-sharded stage).  ``s_local`` is the local
     (two-chunk) length."""
-    axis_size = jax.lax.psum(1, axis_name)
-    my_index = jax.lax.axis_index(axis_name)
-    c = s_local // 2
-    low = my_index * c + jnp.arange(c)
-    high = (2 * axis_size - 1 - my_index) * c + jnp.arange(c)
-    return jnp.concatenate([low, high])
+    return _zigzag_shard_positions(
+        jax.lax.axis_index(axis_name),
+        jax.lax.psum(1, axis_name),
+        s_local // 2,
+    )
 
 
 def ring_attention_zigzag(
@@ -340,16 +455,10 @@ def ring_attention_zigzag(
     if s_local % 2:
         raise ValueError(f"zigzag shard length must be even, got {s_local}")
     c = s_local // 2
-
-    def k_pos_for_src(src):
-        return jnp.concatenate([
-            src * c + jnp.arange(c),
-            (2 * axis_size - 1 - src) * c + jnp.arange(c),
-        ])
-
     return _ring_online_softmax(
         q, k, v, axis_name, causal,
-        zigzag_positions(axis_name, s_local), k_pos_for_src,
+        zigzag_positions(axis_name, s_local),
+        lambda src: _zigzag_shard_positions(src, axis_size, c),
     )
 
 
@@ -418,27 +527,31 @@ def _zigzag_hybrid_forward(q, k, v, axis_name, interpret):
         0, axis_size - 1, step, (k, v, out0, lse0)
     )
     out_blk, lse_blk = block_partial(axis_size - 1, k_last, v_last)
-    out, _ = _merge_partials(out, lse, out_blk, lse_blk)
-    return out.astype(q.dtype)
+    out, lse = _merge_partials(out, lse, out_blk, lse_blk)
+    return out.astype(q.dtype), lse
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _zigzag_hybrid(q, k, v, axis_name, interpret):
-    return _zigzag_hybrid_forward(q, k, v, axis_name, interpret)
+    return _zigzag_hybrid_forward(q, k, v, axis_name, interpret)[0]
 
 
 def _zigzag_hybrid_fwd(q, k, v, axis_name, interpret):
-    return _zigzag_hybrid_forward(q, k, v, axis_name, interpret), (q, k, v)
+    out, lse = _zigzag_hybrid_forward(q, k, v, axis_name, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _zigzag_hybrid_bwd(axis_name, interpret, residuals, g):
-    # exact grads by differentiating the einsum zigzag ring (same math)
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q, k, v: ring_attention_zigzag(q, k, v, axis_name, True),
-        q, k, v,
+    q, k, v, out, lse = residuals
+    axis_size = jax.lax.psum(1, axis_name)
+    s_local = q.shape[2]
+    # no masked_for_src: in the zigzag layout every step has visible
+    # quadrants on every device (q-high always sees k-low)
+    return _ring_backward(
+        q, k, v, out, lse, g, axis_name, True,
+        zigzag_positions(axis_name, s_local),
+        lambda src: _zigzag_shard_positions(src, axis_size, s_local // 2),
     )
-    return vjp(g)
 
 
 _zigzag_hybrid.defvjp(_zigzag_hybrid_fwd, _zigzag_hybrid_bwd)
