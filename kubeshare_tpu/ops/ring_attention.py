@@ -242,6 +242,69 @@ def _ring_flash_forward(q, k, v, axis_name, causal, interpret):
     return out.astype(q.dtype), lse
 
 
+def _sum_heads_to_kv(x, group):
+    """[b, h, sk, d] -> [b, h_kv, sk, d]: query-head groups sum onto
+    their shared KV head."""
+    if group == 1:
+        return x
+    b, h = x.shape[:2]
+    return x.reshape(b, h // group, group, *x.shape[2:]).sum(axis=2)
+
+
+def _bwd_block(q_blk, k_blk, v_blk, g_blk, lse_blk, delta_blk, mask, scale,
+               group):
+    """Flash backward math for one (q-rows x k-cols) block given the
+    GLOBAL lse/delta residual slices: returns (dq_blk, dk_blk, dv_blk).
+    ``mask`` is an optional [sq', sk'] visibility mask; GQA-aware."""
+    scores = _block_scores(q_blk, k_blk, scale)
+    if mask is not None:
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    p = jnp.exp(scores - lse_blk[..., None])
+    p = jnp.where(jnp.isfinite(scores), p, 0.0)
+    dv = _sum_heads_to_kv(jnp.einsum("bhqk,bhqd->bhkd", p, g_blk), group)
+    dp = _block_scores(g_blk, v_blk.astype(jnp.float32), 1.0)
+    ds = p * (dp - delta_blk[..., None]) * scale
+    dq = _block_pv(ds, k_blk.astype(jnp.float32))
+    dk = _sum_heads_to_kv(
+        jnp.einsum("bhqk,bhqd->bhkd", ds, q_blk.astype(jnp.float32)), group)
+    return dq, dk, dv
+
+
+def _ring_bwd_loop(q, k, v, step_math, axis_name):
+    """Shared backward ring scheduler: K/V rotate forward while the
+    dK/dV partial accumulators rotate with them (always aligned with
+    their block), so after the full loop each partial lands back on its
+    home device.  The final block is peeled so its dead K/V rotation is
+    never issued — the dk/dv partials still need their last homing hop.
+    ``step_math(t, k_cur, v_cur, dk, dv, dq) -> (dk, dv, dq)`` supplies
+    the per-block math; everything rotation/carry-typing related lives
+    here once."""
+    axis_size = jax.lax.psum(1, axis_name)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(t, carry):
+        k_cur, v_cur, dk_cur, dv_cur, dq = carry
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_cur, dv_cur, dq = step_math(t, k_cur, v_cur, dk_cur, dv_cur, dq)
+        dk_next = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_next = jax.lax.ppermute(dv_cur, axis_name, perm)
+        return k_next, v_next, dk_next, dv_next, dq
+
+    # accumulators seeded device-varying for the shard_map carry check
+    varying = (jax.lax.axis_index(axis_name) * 0).astype(jnp.float32)
+    dq0 = jnp.zeros(q.shape, jnp.float32) + varying
+    dk0 = jnp.zeros(k.shape, jnp.float32) + varying
+    dv0 = jnp.zeros(v.shape, jnp.float32) + varying
+    k_last, v_last, dk, dv, dq = jax.lax.fori_loop(
+        0, axis_size - 1, step, (k, v, dk0, dv0, dq0)
+    )
+    dk, dv, dq = step_math(axis_size - 1, k_last, v_last, dk, dv, dq)
+    dk = jax.lax.ppermute(dk, axis_name, perm)
+    dv = jax.lax.ppermute(dv, axis_name, perm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
 def _ring_backward(q, k, v, out, lse, g, axis_name, causal, q_pos,
                    k_pos_for_src, masked_for_src=None):
     """Hand-scheduled ring backward from saved forward residuals.
@@ -253,8 +316,9 @@ def _ring_backward(q, k, v, out, lse, g, axis_name, causal, q_pos,
     dq += ds k, dk += ds^T q — about 2x forward FLOPs.  dK/dV partials
     rotate WITH their K/V blocks, so after the full loop each lands back
     on its home device; exactly one ppermute chain per tensor, all ICI
-    neighbor traffic.  Layout-agnostic via the same position callbacks as
-    the forward (contiguous and zigzag both route here).
+    neighbor traffic.  Position callbacks abstract the shard layout;
+    the zigzag layout has its own quadrant-skipping specialization
+    (:func:`_zigzag_ring_backward`).
 
     ``masked_for_src(src)`` (bool scalar) marks steps whose block is
     FULLY masked on this device — their contribution is exactly zero, so
@@ -263,43 +327,23 @@ def _ring_backward(q, k, v, out, lse, g, axis_name, causal, q_pos,
     backward)."""
     axis_size = jax.lax.psum(1, axis_name)
     my_index = jax.lax.axis_index(axis_name)
-    b, h, sq, d = q.shape
-    h_kv = k.shape[1]
-    group = h // h_kv
+    d = q.shape[-1]
+    group = q.shape[1] // k.shape[1]
     scale = d**-0.5
-    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
     g32 = g.astype(jnp.float32)
     delta = jnp.sum(g32 * out.astype(jnp.float32), axis=-1)  # [b,h,sq]
 
-    def sum_heads_to_kv(x):
-        # [b, h, sk, d] -> [b, h_kv, sk, d]: query-head groups sum onto
-        # their shared KV head
-        if group == 1:
-            return x
-        return x.reshape(b, h_kv, group, *x.shape[2:]).sum(axis=2)
-
     def block_math(args):
         src, k_cur, v_cur, dk_cur, dv_cur, dq = args
-        scores = _block_scores(q, k_cur, scale)  # [b,h,sq,sk] f32
-        if causal:
-            mask = q_pos[:, None] >= k_pos_for_src(src)[None, :]
-            scores = jnp.where(mask[None, None], scores, -jnp.inf)
-        # lse is the GLOBAL logsumexp from the forward: p is each block's
-        # final (fully-normalized) probability slice
-        p = jnp.exp(scores - lse[..., None])
-        p = jnp.where(jnp.isfinite(scores), p, 0.0)
-
-        # dv += p^T g  (grouped onto KV heads)
-        dv_cur = dv_cur + sum_heads_to_kv(
-            jnp.einsum("bhqk,bhqd->bhkd", p, g32))
-        # dp = g v^T -> ds = p * (dp - delta) * scale
-        dp = _block_scores(g32, v_cur.astype(jnp.float32), 1.0)
-        ds = p * (dp - delta[..., None]) * scale
-        dq = dq + _block_pv(ds, k_cur.astype(jnp.float32))
-        dk_cur = dk_cur + sum_heads_to_kv(
-            jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32)))
-        return dk_cur, dv_cur, dq
+        # lse is the GLOBAL logsumexp from the forward: p inside
+        # _bwd_block is each block's final (fully-normalized)
+        # probability slice
+        mask = (q_pos[:, None] >= k_pos_for_src(src)[None, :]
+                if causal else None)
+        dq_blk, dk_blk, dv_blk = _bwd_block(
+            q, k_cur, v_cur, g32, lse, delta, mask, scale, group)
+        return dk_cur + dk_blk, dv_cur + dv_blk, dq + dq_blk
 
     def step_math(t, k_cur, v_cur, dk_cur, dv_cur, dq):
         src = (my_index - t) % axis_size
@@ -313,29 +357,7 @@ def _ring_backward(q, k, v, out, lse, g, axis_name, causal, q_pos,
             args,
         )
 
-    def step(t, carry):
-        k_cur, v_cur, dk_cur, dv_cur, dq = carry
-        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
-        dk_cur, dv_cur, dq = step_math(t, k_cur, v_cur, dk_cur, dv_cur, dq)
-        dk_next = jax.lax.ppermute(dk_cur, axis_name, perm)
-        dv_next = jax.lax.ppermute(dv_cur, axis_name, perm)
-        return k_next, v_next, dk_next, dv_next, dq
-
-    varying = (jax.lax.axis_index(axis_name) * 0).astype(jnp.float32)
-    dq0 = jnp.zeros(q.shape, jnp.float32) + varying
-    dk0 = jnp.zeros(k.shape, jnp.float32) + varying
-    dv0 = jnp.zeros(v.shape, jnp.float32) + varying
-    # blocks 0..axis_size-2 in the loop; the final block is peeled so its
-    # dead K/V rotation is never issued (the dk/dv partials still need
-    # their last homing hop)
-    k_last, v_last, dk, dv, dq = jax.lax.fori_loop(
-        0, axis_size - 1, step, (k, v, dk0, dv0, dq0)
-    )
-    dk, dv, dq = step_math(axis_size - 1, k_last, v_last, dk, dv, dq)
-    dk = jax.lax.ppermute(dk, axis_name, perm)
-    dv = jax.lax.ppermute(dv, axis_name, perm)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return _ring_bwd_loop(q, k, v, step_math, axis_name)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -541,17 +563,78 @@ def _zigzag_hybrid_fwd(q, k, v, axis_name, interpret):
     return out, (q, k, v, out, lse)
 
 
+def _zigzag_ring_backward(q, k, v, out, lse, g, axis_name):
+    """Quadrant-skipping backward for the zigzag layout: the same three
+    static cases as the forward — earlier sources touch only [2c x c]
+    (all q rows x k-low), later sources only [c x 2c] (q-high x all k),
+    the diagonal its two causal c x c quadrants plus one full c x c —
+    so the backward stays balanced at ~half a block per step per device,
+    mirroring the forward's win (a generic positions-mask backward would
+    compute full [2c x 2c] scores every step)."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_index = jax.lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    h_kv = k.shape[1]
+    group = h // h_kv
+    c = s_local // 2
+    scale = d**-0.5
+
+    g32 = g.astype(jnp.float32)
+    delta = jnp.sum(g32 * out.astype(jnp.float32), axis=-1)
+    q_lo, q_hi = q[:, :, :c], q[:, :, c:]
+    g_lo, g_hi = g32[:, :, :c], g32[:, :, c:]
+    lse_lo, lse_hi = lse[:, :, :c], lse[:, :, c:]
+    d_lo, d_hi = delta[:, :, :c], delta[:, :, c:]
+    diag_mask = jnp.arange(c)[:, None] >= jnp.arange(c)[None, :]
+    zq = jnp.zeros((b, h, c, d), jnp.float32)
+    zk = jnp.zeros((b, h_kv, c, d), jnp.float32)
+
+    def earlier(args):
+        # src < my: every q row sees k-low only
+        k_cur, v_cur, dk_cur, dv_cur, dq = args
+        dq_blk, dk_lo, dv_lo = _bwd_block(
+            q, k_cur[:, :, :c], v_cur[:, :, :c], g32, lse, delta, None,
+            scale, group)
+        pad = lambda lo: jnp.concatenate([lo, zk], axis=2)
+        return dk_cur + pad(dk_lo), dv_cur + pad(dv_lo), dq + dq_blk
+
+    def later(args):
+        # src > my: only q-high sees anything (both k chunks)
+        k_cur, v_cur, dk_cur, dv_cur, dq = args
+        dq_hi, dk_blk, dv_blk = _bwd_block(
+            q_hi, k_cur, v_cur, g_hi, lse_hi, d_hi, None, scale, group)
+        dq = dq + jnp.concatenate([zq, dq_hi], axis=2)
+        return dk_cur + dk_blk, dv_cur + dv_blk, dq
+
+    def diagonal(args):
+        k_cur, v_cur, dk_cur, dv_cur, dq = args
+        k_lo, k_hi = k_cur[:, :, :c], k_cur[:, :, c:]
+        v_lo, v_hi = v_cur[:, :, :c], v_cur[:, :, c:]
+        dq_ll, dk_ll, dv_ll = _bwd_block(
+            q_lo, k_lo, v_lo, g_lo, lse_lo, d_lo, diag_mask, scale, group)
+        dq_hl, dk_hl, dv_hl = _bwd_block(
+            q_hi, k_lo, v_lo, g_hi, lse_hi, d_hi, None, scale, group)
+        dq_hh, dk_hh, dv_hh = _bwd_block(
+            q_hi, k_hi, v_hi, g_hi, lse_hi, d_hi, diag_mask, scale, group)
+        dq = dq + jnp.concatenate([dq_ll, dq_hl + dq_hh], axis=2)
+        dk_cur = dk_cur + jnp.concatenate([dk_ll + dk_hl, dk_hh], axis=2)
+        dv_cur = dv_cur + jnp.concatenate([dv_ll + dv_hl, dv_hh], axis=2)
+        return dk_cur, dv_cur, dq
+
+    def step_math(t, k_cur, v_cur, dk_cur, dv_cur, dq):
+        src = (my_index - t) % axis_size
+        branch = jnp.where(src == my_index, 2,
+                           jnp.where(src < my_index, 0, 1))
+        return jax.lax.switch(
+            branch, (earlier, later, diagonal),
+            (k_cur, v_cur, dk_cur, dv_cur, dq))
+
+    return _ring_bwd_loop(q, k, v, step_math, axis_name)
+
+
 def _zigzag_hybrid_bwd(axis_name, interpret, residuals, g):
     q, k, v, out, lse = residuals
-    axis_size = jax.lax.psum(1, axis_name)
-    s_local = q.shape[2]
-    # no masked_for_src: in the zigzag layout every step has visible
-    # quadrants on every device (q-high always sees k-low)
-    return _ring_backward(
-        q, k, v, out, lse, g, axis_name, True,
-        zigzag_positions(axis_name, s_local),
-        lambda src: _zigzag_shard_positions(src, axis_size, s_local // 2),
-    )
+    return _zigzag_ring_backward(q, k, v, out, lse, g, axis_name)
 
 
 _zigzag_hybrid.defvjp(_zigzag_hybrid_fwd, _zigzag_hybrid_bwd)
