@@ -194,6 +194,36 @@ class TestDeployManifests:
         assert any(e.is_multi_nodes for e in elements.values())
 
 
+class TestLongContextExample:
+    """examples/train_longcontext.py: the round-3 parallelism walkthrough
+    must actually train (loss decreases) on the CPU mesh, on both the
+    dp x sp (fsdp + zigzag) and 1F1B x sp paths."""
+
+    def _run(self, *extra):
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=8")
+        out = subprocess.run(
+            [sys.executable, "-m", "examples.train_longcontext",
+             "--steps", "2", *extra],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        return out.stdout
+
+    def test_fsdp_zigzag_path(self):
+        stdout = self._run()
+        assert "zigzag ring" in stdout
+        assert "demo complete" in stdout
+
+    def test_1f1b_path(self):
+        stdout = self._run("--pp")
+        assert "1f1b" in stdout
+        # the example asserts loss improvement itself; completion marker
+        # proves it got past that check
+        assert "demo complete" in stdout
+
+
 class TestContainerBuildSurface:
     """The packaging surface the reference ships as docker/*/Dockerfile +
     Makefile image targets (ref Makefile:1-20): one image, `make images`,
