@@ -30,7 +30,8 @@
 //        XLA allocator-fraction env from TPUSHARE_MEM_FRACTION before the
 //        runtime starts, and PJRT_Client_Create injects memory_fraction /
 //        preallocate=false create options (retried without them when the
-//        plugin rejects unknown options — fail open, never fail the client);
+//        plugin rejects them as unknown — INVALID_ARGUMENT/UNIMPLEMENTED;
+//        any other create failure is the caller's and propagates unchanged);
 //      - executable outputs: after each Execute the output buffers are
 //        charged on first sighting (size via Buffer_OnDeviceSizeInBytes).
 //        An output the broker denies goes on a local OVERFLOW ledger: the
@@ -38,7 +39,9 @@
 //        execute is denied until enough buffers are destroyed.
 //  * Accounting is symmetric: only buffers this shim charged are credited
 //    back on destroy, by exactly the charged amount — the ledger can
-//    never drift toward zero from buffers it never saw.
+//    never drift toward zero from buffers it never saw.  Client destroy
+//    releases every buffer wholesale, so it settles all ledgers and
+//    credits the broker for the outstanding charge.
 //
 // The PJRT_Api table is copied and entry pointers swapped; a struct_size
 // check skips hooking when the runtime's API is older than the header we
@@ -83,6 +86,7 @@ PJRT_Error* (*g_real_error_get_code)(PJRT_Error_GetCode_Args*) = nullptr;
 PJRT_Error* (*g_real_event_on_ready)(PJRT_Event_OnReady_Args*) = nullptr;
 PJRT_Error* (*g_real_event_destroy)(PJRT_Event_Destroy_Args*) = nullptr;
 PJRT_Error* (*g_real_client_create)(PJRT_Client_Create_Args*) = nullptr;
+PJRT_Error* (*g_real_client_destroy)(PJRT_Client_Destroy_Args*) = nullptr;
 PJRT_Error* (*g_real_buffer_size)(PJRT_Buffer_OnDeviceSizeInBytes_Args*) =
     nullptr;
 PJRT_Error* (*g_real_get_executable)(PJRT_LoadedExecutable_GetExecutable_Args*) =
@@ -104,6 +108,20 @@ void DestroyRealError(PJRT_Error* error) {
   args.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
   args.error = error;
   g_real_error_destroy(&args);
+}
+
+// Code of a plugin-owned error, or -1 when it cannot be read.
+int RealErrorCode(PJRT_Error* error) {
+  if (error == nullptr || g_real_error_get_code == nullptr) return -1;
+  PJRT_Error_GetCode_Args args;
+  std::memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Error_GetCode_Args_STRUCT_SIZE;
+  args.error = error;
+  if (PJRT_Error* err = g_real_error_get_code(&args)) {
+    DestroyRealError(err);
+    return -1;
+  }
+  return static_cast<int>(args.code);
 }
 
 // TPUSHARE_MEM_FRACTION parsed once; <= 0 when absent/invalid.
@@ -656,15 +674,45 @@ PJRT_Error* HookedClientCreate(PJRT_Client_Create_Args* args) {
                  "preallocate=false\n", fraction);
     return nullptr;
   }
-  // plugin rejected the injected options (or the create failed for any
-  // reason): retry exactly as the caller asked, so the shim never turns a
-  // working client into a broken one
+  // Retry bare only when the failure looks like option rejection
+  // (INVALID_ARGUMENT / UNIMPLEMENTED, or unreadable code on an old
+  // plugin).  Any other failure — OOM, transient init error — is the
+  // caller's to see: a blind retry would destroy the original error and
+  // hand a partially-initialized plugin a second create.
+  int code = RealErrorCode(err);
+  bool option_rejection = code < 0 ||
+                          code == PJRT_Error_Code_INVALID_ARGUMENT ||
+                          code == PJRT_Error_Code_UNIMPLEMENTED;
+  if (!option_rejection) return err;
   DestroyRealError(err);
   std::fprintf(stderr,
-               "tpushim: plugin rejected allocator-cap create options, "
-               "retrying without them (cap enforced by upload/output "
-               "accounting only)\n");
+               "tpushim: plugin rejected allocator-cap create options "
+               "(code %d), retrying without them (cap enforced by "
+               "upload/output accounting only)\n", code);
   return g_real_client_create(args);
+}
+
+// Client destroy releases every buffer the client owns without a
+// per-buffer PJRT_Buffer_Destroy, so the ledgers must be settled here or
+// a pod that re-creates its client stays charged (and, in hard mode,
+// permanently denied once over cap).  The shim gates a single plugin and
+// in practice a single client; with several live clients this over-credits
+// transiently, which the broker clamps at zero (tokend Mem(): next < 0 ->
+// 0), so the failure mode is brief under-counting, never a stuck denial.
+PJRT_Error* HookedClientDestroy(PJRT_Client_Destroy_Args* args) {
+  if (g_gated) {
+    long long credit = 0;
+    {
+      std::lock_guard<std::mutex> lock(g_mem_mu);
+      for (const auto& kv : ChargedBuffers()) credit += kv.second;
+      ChargedBuffers().clear();
+      OverflowBuffers().clear();
+      g_overflow_bytes = 0;
+      NumOutputsCache().clear();
+    }
+    if (credit > 0) tpushare_mem_request(-credit);
+  }
+  return g_real_client_destroy(args);
 }
 
 // ---------------------------------------------------------------------------
@@ -703,6 +751,7 @@ const PJRT_Api* WrapApi(const PJRT_Api* real) {
   g_real_event_on_ready = wrapped.PJRT_Event_OnReady;
   g_real_event_destroy = wrapped.PJRT_Event_Destroy;
   g_real_client_create = wrapped.PJRT_Client_Create;
+  g_real_client_destroy = wrapped.PJRT_Client_Destroy;
   g_real_buffer_size = wrapped.PJRT_Buffer_OnDeviceSizeInBytes;
   g_real_get_executable = wrapped.PJRT_LoadedExecutable_GetExecutable;
   g_real_executable_num_outputs = wrapped.PJRT_Executable_NumOutputs;
@@ -716,6 +765,9 @@ const PJRT_Api* WrapApi(const PJRT_Api* real) {
   }
   if (g_real_client_create != nullptr) {
     wrapped.PJRT_Client_Create = HookedClientCreate;
+  }
+  if (g_real_client_destroy != nullptr) {
+    wrapped.PJRT_Client_Destroy = HookedClientDestroy;
   }
   if (g_real_loaded_destroy != nullptr) {
     wrapped.PJRT_LoadedExecutable_Destroy = HookedLoadedExecutableDestroy;

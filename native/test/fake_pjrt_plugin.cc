@@ -36,6 +36,8 @@ namespace {
 std::atomic<int> g_execute_calls{0};
 std::atomic<int> g_buffer_calls{0};
 std::atomic<int> g_destroy_calls{0};
+std::atomic<int> g_client_creates{0};
+std::atomic<int> g_client_destroys{0};
 std::atomic<int> g_events_created{0};
 std::atomic<int> g_events_fired{0};
 std::atomic<int> g_events_destroyed{0};
@@ -249,6 +251,7 @@ PJRT_Error* FakeExecutableDestroy(PJRT_Executable_Destroy_Args*) {
 }
 
 PJRT_Error* FakeClientCreate(PJRT_Client_Create_Args* args) {
+  g_client_creates++;
   std::string seen;
   for (size_t i = 0; i < args->num_options; i++) {
     const PJRT_NamedValue& option = args->create_options[i];
@@ -282,7 +285,21 @@ PJRT_Error* FakeClientCreate(PJRT_Client_Create_Args* args) {
     return reinterpret_cast<PJRT_Error*>(new FakeError{
         "fake plugin: unknown create options", PJRT_Error_Code_INVALID_ARGUMENT});
   }
+  // FAKE_CREATE_FAIL_CODE=<n>: every create fails with that code — models a
+  // plugin whose init fails for a NON-option reason (OOM, transient), which
+  // the interposer must propagate rather than retry
+  const char* fail_code = std::getenv("FAKE_CREATE_FAIL_CODE");
+  if (fail_code != nullptr && *fail_code != '\0') {
+    return reinterpret_cast<PJRT_Error*>(new FakeError{
+        "fake plugin: create failed",
+        static_cast<PJRT_Error_Code>(std::atoi(fail_code))});
+  }
   args->client = reinterpret_cast<PJRT_Client*>(g_next_handle.fetch_add(16));
+  return nullptr;
+}
+
+PJRT_Error* FakeClientDestroy(PJRT_Client_Destroy_Args*) {
+  g_client_destroys++;
   return nullptr;
 }
 
@@ -308,6 +325,8 @@ extern "C" {
 
 int fake_execute_calls(void) { return g_execute_calls.load(); }
 int fake_buffer_calls(void) { return g_buffer_calls.load(); }
+int fake_client_creates(void) { return g_client_creates.load(); }
+int fake_client_destroys(void) { return g_client_destroys.load(); }
 int fake_destroy_calls(void) { return g_destroy_calls.load(); }
 int fake_events_created(void) { return g_events_created.load(); }
 int fake_events_fired(void) { return g_events_fired.load(); }
@@ -340,6 +359,7 @@ const PJRT_Api* GetPjrtApi(void) {
     api.PJRT_Buffer_Destroy = FakeBufferDestroy;
     api.PJRT_Buffer_OnDeviceSizeInBytes = FakeOnDeviceSize;
     api.PJRT_Client_Create = FakeClientCreate;
+    api.PJRT_Client_Destroy = FakeClientDestroy;
     api.PJRT_LoadedExecutable_GetExecutable = FakeGetExecutable;
     api.PJRT_Executable_NumOutputs = FakeNumOutputs;
     api.PJRT_Executable_Destroy = FakeExecutableDestroy;

@@ -16,7 +16,10 @@
 //                      attempt (frees the charged HBM first)
 //   --create-client    call PJRT_Client_Create with zero options first;
 //                      prints "client_ok options=<recorded>" or
-//                      "client_err"
+//                      "client_err code=<c>" with the creates-seen count
+//   --destroy-client   after the upload attempt, call PJRT_Client_Destroy
+//                      and retry the upload; prints "client_destroyed" and
+//                      "upload2_ok" / "upload2_denied code=<c>"
 //   --sleep-ms S       sleep S ms before exit (lets async completion
 //                      callbacks deliver their RET to the tokend)
 
@@ -32,6 +35,40 @@
 
 #include "xla/pjrt/c/pjrt_c_api.h"
 
+namespace {
+
+PJRT_Error_Code ErrorCode(const PJRT_Api* api, PJRT_Error* error) {
+  if (api->PJRT_Error_GetCode == nullptr) return PJRT_Error_Code_UNKNOWN;
+  PJRT_Error_GetCode_Args args;
+  std::memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Error_GetCode_Args_STRUCT_SIZE;
+  args.error = error;
+  api->PJRT_Error_GetCode(&args);
+  return args.code;
+}
+
+std::string ErrorMessage(const PJRT_Api* api, PJRT_Error* error) {
+  if (api->PJRT_Error_Message == nullptr) return "<none>";
+  PJRT_Error_Message_Args args;
+  std::memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  args.error = error;
+  api->PJRT_Error_Message(&args);
+  if (args.message == nullptr) return "<none>";
+  return std::string(args.message, args.message_size);
+}
+
+void DestroyError(const PJRT_Api* api, PJRT_Error* error) {
+  if (error == nullptr || api->PJRT_Error_Destroy == nullptr) return;
+  PJRT_Error_Destroy_Args args;
+  std::memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  args.error = error;
+  api->PJRT_Error_Destroy(&args);
+}
+
+}  // namespace
+
 int main(int argc, char** argv) {
   if (argc < 3) {
     std::fprintf(stderr, "usage: %s <plugin.so> <n> [options]\n", argv[0]);
@@ -42,6 +79,7 @@ int main(int argc, char** argv) {
   bool caller_events = false;
   bool destroy_outputs = false;
   bool create_client = false;
+  bool destroy_client = false;
   int num_outputs = 0;
   int sleep_ms = 0;
   for (int i = 3; i < argc; i++) {
@@ -59,6 +97,8 @@ int main(int argc, char** argv) {
       destroy_outputs = true;
     } else if (flag == "--create-client") {
       create_client = true;
+    } else if (flag == "--destroy-client") {
+      destroy_client = true;
     } else if (flag == "--sleep-ms" && i + 1 < argc) {
       sleep_ms = std::atoi(argv[++i]);
     }
@@ -81,6 +121,9 @@ int main(int argc, char** argv) {
     return 1;
   }
 
+  PJRT_Client* client = nullptr;
+  auto creates_seen = reinterpret_cast<int (*)()>(
+      dlsym(handle, "fake_client_creates"));
   if (create_client) {
     PJRT_Client_Create_Args create_args;
     std::memset(&create_args, 0, sizeof(create_args));
@@ -89,18 +132,16 @@ int main(int argc, char** argv) {
     auto recorded = reinterpret_cast<const char* (*)()>(
         dlsym(handle, "fake_client_create_options"));
     if (create_err == nullptr) {
-      std::printf("client_ok options=%s\n",
-                  recorded != nullptr ? recorded() : "?");
+      client = create_args.client;
+      std::printf("client_ok options=%s creates=%d\n",
+                  recorded != nullptr ? recorded() : "?",
+                  creates_seen != nullptr ? creates_seen() : -1);
     } else {
-      std::printf("client_err options=%s\n",
-                  recorded != nullptr ? recorded() : "?");
-      if (api->PJRT_Error_Destroy != nullptr) {
-        PJRT_Error_Destroy_Args destroy_args;
-        std::memset(&destroy_args, 0, sizeof(destroy_args));
-        destroy_args.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-        destroy_args.error = create_err;
-        api->PJRT_Error_Destroy(&destroy_args);
-      }
+      std::printf("client_err code=%d options=%s creates=%d\n",
+                  static_cast<int>(ErrorCode(api, create_err)),
+                  recorded != nullptr ? recorded() : "?",
+                  creates_seen != nullptr ? creates_seen() : -1);
+      DestroyError(api, create_err);
     }
   }
 
@@ -123,23 +164,9 @@ int main(int argc, char** argv) {
     if (num_outputs > 0) args.output_lists = output_list;
     PJRT_Error* exec_err = api->PJRT_LoadedExecutable_Execute(&args);
     if (exec_err != nullptr) {
-      PJRT_Error_Code code = PJRT_Error_Code_UNKNOWN;
-      if (api->PJRT_Error_GetCode != nullptr) {
-        PJRT_Error_GetCode_Args code_args;
-        std::memset(&code_args, 0, sizeof(code_args));
-        code_args.struct_size = PJRT_Error_GetCode_Args_STRUCT_SIZE;
-        code_args.error = exec_err;
-        api->PJRT_Error_GetCode(&code_args);
-        code = code_args.code;
-      }
-      std::printf("execute_denied i=%d code=%d\n", i, static_cast<int>(code));
-      if (api->PJRT_Error_Destroy != nullptr) {
-        PJRT_Error_Destroy_Args destroy_args;
-        std::memset(&destroy_args, 0, sizeof(destroy_args));
-        destroy_args.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-        destroy_args.error = exec_err;
-        api->PJRT_Error_Destroy(&destroy_args);
-      }
+      std::printf("execute_denied i=%d code=%d\n", i,
+                  static_cast<int>(ErrorCode(api, exec_err)));
+      DestroyError(api, exec_err);
       continue;
     }
     for (PJRT_Buffer* buffer : output_slots) {
@@ -180,7 +207,8 @@ int main(int argc, char** argv) {
 
   // one host->device upload of upload_bytes (f32), destroyed again unless
   // kept: exercises the HBM accounting + hard-denial hooks
-  if (api->PJRT_Client_BufferFromHostBuffer != nullptr) {
+  auto attempt_upload = [&](const char* tag) {
+    if (api->PJRT_Client_BufferFromHostBuffer == nullptr) return;
     PJRT_Client_BufferFromHostBuffer_Args buffer_args;
     std::memset(&buffer_args, 0, sizeof(buffer_args));
     buffer_args.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
@@ -190,37 +218,12 @@ int main(int argc, char** argv) {
     buffer_args.num_dims = 1;
     PJRT_Error* err = api->PJRT_Client_BufferFromHostBuffer(&buffer_args);
     if (err != nullptr) {
-      PJRT_Error_Code code = PJRT_Error_Code_UNKNOWN;
-      if (api->PJRT_Error_GetCode != nullptr) {
-        PJRT_Error_GetCode_Args code_args;
-        std::memset(&code_args, 0, sizeof(code_args));
-        code_args.struct_size = PJRT_Error_GetCode_Args_STRUCT_SIZE;
-        code_args.error = err;
-        api->PJRT_Error_GetCode(&code_args);
-        code = code_args.code;
-      }
-      std::string message = "<none>";
-      if (api->PJRT_Error_Message != nullptr) {
-        PJRT_Error_Message_Args msg_args;
-        std::memset(&msg_args, 0, sizeof(msg_args));
-        msg_args.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
-        msg_args.error = err;
-        api->PJRT_Error_Message(&msg_args);
-        if (msg_args.message != nullptr) {
-          message.assign(msg_args.message, msg_args.message_size);
-        }
-      }
-      std::printf("upload_denied code=%d msg=%s\n", static_cast<int>(code),
-                  message.c_str());
-      if (api->PJRT_Error_Destroy != nullptr) {
-        PJRT_Error_Destroy_Args destroy_args;
-        std::memset(&destroy_args, 0, sizeof(destroy_args));
-        destroy_args.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-        destroy_args.error = err;
-        api->PJRT_Error_Destroy(&destroy_args);
-      }
+      std::printf("%s_denied code=%d msg=%s\n", tag,
+                  static_cast<int>(ErrorCode(api, err)),
+                  ErrorMessage(api, err).c_str());
+      DestroyError(api, err);
     } else {
-      std::printf("upload_ok\n");
+      std::printf("%s_ok\n", tag);
       if (!keep_buffer && api->PJRT_Buffer_Destroy != nullptr &&
           buffer_args.buffer != nullptr) {
         PJRT_Buffer_Destroy_Args destroy_args;
@@ -230,6 +233,20 @@ int main(int argc, char** argv) {
         api->PJRT_Buffer_Destroy(&destroy_args);
       }
     }
+  };
+  attempt_upload("upload");
+
+  if (destroy_client && api->PJRT_Client_Destroy != nullptr) {
+    PJRT_Client_Destroy_Args destroy_args;
+    std::memset(&destroy_args, 0, sizeof(destroy_args));
+    destroy_args.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+    destroy_args.client = client;  // fake plugin ignores the handle
+    DestroyError(api, api->PJRT_Client_Destroy(&destroy_args));
+    auto destroys_seen = reinterpret_cast<int (*)()>(
+        dlsym(handle, "fake_client_destroys"));
+    std::printf("client_destroyed destroys=%d\n",
+                destroys_seen != nullptr ? destroys_seen() : -1);
+    attempt_upload("upload2");
   }
 
   if (sleep_ms > 0) {

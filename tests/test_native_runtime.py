@@ -538,9 +538,43 @@ class TestInterposer:
         )
         assert out.returncode == 0, out.stderr
         # retry succeeded; the recorded options from the final (bare) call
-        # are empty
-        assert "client_ok options=\n" in out.stdout
+        # are empty, and the plugin saw exactly two creates
+        assert "client_ok options= creates=2" in out.stdout
         assert "retrying without them" in out.stderr
+
+    def test_client_create_error_propagated(self, tokend):
+        """A create failure that is NOT option rejection (RESOURCE_EXHAUSTED
+        here) must reach the caller unchanged with no bare retry — a blind
+        retry would destroy the original error and hand a partially
+        initialized plugin a second create (ADVICE r3)."""
+        out, _ = self._run_driver(
+            tokend, ["0", "--create-client"],
+            extra_env={"TPUSHARE_MEM_FRACTION": "0.5",
+                       "FAKE_CREATE_FAIL_CODE": "8"},
+        )
+        assert out.returncode == 0, out.stderr
+        assert "client_err code=8" in out.stdout
+        assert "creates=1" in out.stdout  # no second (bare) create
+        assert "retrying without them" not in out.stderr
+
+    def test_client_destroy_settles_ledgers(self, tokend):
+        """Client destroy releases every buffer the client owns without
+        per-buffer destroys: the shim must clear the charged + overflow
+        ledgers and credit the broker, or a pod that re-creates its client
+        stays over-cap (denied) for the process lifetime (ADVICE r3)."""
+        out, stat = self._run_driver(
+            tokend,
+            ["3", "--outputs", "1", "--destroy-client"],
+            extra_env={"FAKE_OUTPUT_BYTES": "600000"},  # cap 1000000
+        )
+        assert out.returncode == 0, out.stderr
+        # over-cap before the destroy: the first upload is denied
+        assert "upload_denied code=8" in out.stdout
+        # destroy clears the overflow and credits the broker: the retry
+        # upload goes through and is itself settled on buffer destroy
+        assert "client_destroyed destroys=1" in out.stdout
+        assert "upload2_ok" in out.stdout
+        assert stat["pods"]["ns/pod-a"]["mem_used"] == 0
 
     def test_preload_exports_allocator_env(self, tokend):
         """The shim's constructor translates TPUSHARE_MEM_FRACTION into the
