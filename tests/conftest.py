@@ -20,3 +20,74 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def _build_native() -> None:
+    """Build the native runtime, interposer fixtures, and TSAN binaries so a
+    fresh checkout runs the full isolation suite instead of silently
+    skipping it (VERDICT r3 #3).  A failed build raises — the tests guarding
+    the isolation runtime must never disappear quietly.  Hosts without a
+    toolchain (no make/g++) keep the existing skip markers.
+    """
+    import shutil
+    import subprocess
+
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        return
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    native = os.path.join(repo, "native")
+    if not os.path.isdir(native):
+        return
+
+    artifacts = [
+        os.path.join(native, "build", name)
+        for name in (
+            "tpushare-tokend", "tpushare-pmgr", "libtpushare_client.so",
+            "libtpushim.so.1", "fake_pjrt_plugin.so", "interposer_driver",
+            "tpushare-tokend-tsan", "tpushare-pmgr-tsan",
+        )
+    ]
+    sources = [os.path.join(native, "Makefile")]
+    for sub in ("", "shim", "test"):
+        directory = os.path.join(native, sub)
+        sources += [
+            os.path.join(directory, f)
+            for f in os.listdir(directory)
+            if f.endswith((".cc", ".h"))
+        ]
+    newest_source = max(os.path.getmtime(p) for p in sources)
+    if all(
+        os.path.exists(p) and os.path.getmtime(p) >= newest_source
+        for p in artifacts
+    ):
+        return  # up to date: skip make (its PJRT_INC probe costs seconds)
+
+    # -B: this check is broader than make's own prerequisites (Makefile and
+    # header edits count as stale here) — an incremental make would no-op on
+    # those and leave the artifacts permanently older than newest_source
+    proc = subprocess.run(
+        ["make", "-B", "-C", native, "all", "test-fixtures"],
+        capture_output=True, text=True,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            "native build failed — the isolation-runtime tests would be "
+            f"silently skipped:\n{proc.stdout}\n{proc.stderr}"
+        )
+    # TSAN needs the sanitizer runtime, which a make/g++ host may lack:
+    # build it best-effort and warn loudly instead of killing the whole
+    # session's pure-Python tests over a missing libtsan
+    tsan = subprocess.run(
+        ["make", "-B", "-C", native, "tsan"], capture_output=True, text=True,
+    )
+    if tsan.returncode != 0:
+        import warnings
+
+        warnings.warn(
+            "TSAN build failed — the tokend race-detection test will be "
+            f"SKIPPED:\n{tsan.stderr[-500:]}",
+            stacklevel=1,
+        )
+
+
+_build_native()
