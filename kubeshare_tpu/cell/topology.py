@@ -149,6 +149,36 @@ def generate_tpu_topology(
     return {"cellTypes": cell_types, "cells": cells}
 
 
+def chip_box(coords: Sequence[Optional[Sequence[int]]], n_chips: int) -> str:
+    """Bounding-box shape of a chip selection as libtpu bounds syntax.
+
+    The scheduler injects ``TPU_CHIPS_PER_PROCESS_BOUNDS`` so a pod granted a
+    subset of a host's chips initializes its runtime over exactly that
+    sub-mesh (the visibility contract the reference filled with
+    NVIDIA_VISIBLE_DEVICES, ref pkg/scheduler/pod.go:388-396; SURVEY §7.2
+    names the TPU equivalents).  When every selected cell carries ICI mesh
+    coords and the selection tiles its bounding box exactly, the box dims
+    are emitted (``"2,1,1"``); a gappy or coordinate-less selection falls
+    back to a linear ``"<n>,1,1"`` bound, which libtpu accepts for any
+    chip list.
+    """
+    known = [tuple(c) for c in coords if c]
+    if len(known) != n_chips or n_chips == 0:
+        return f"{max(n_chips, 1)},1,1"
+    ndim = max(len(c) for c in known)
+    padded = [tuple(c) + (0,) * (ndim - len(c)) for c in known]
+    lows = [min(c[i] for c in padded) for i in range(ndim)]
+    highs = [max(c[i] for c in padded) for i in range(ndim)]
+    dims = [highs[i] - lows[i] + 1 for i in range(ndim)]
+    box_volume = 1
+    for d in dims:
+        box_volume *= d
+    if box_volume != n_chips or len(set(padded)) != n_chips:
+        return f"{n_chips},1,1"  # gaps or duplicates: not a clean sub-mesh
+    dims += [1] * (3 - ndim)
+    return ",".join(str(d) for d in dims[:3])
+
+
 def generate_tpu_topology_config(
     nodes: Iterable[Tuple[str, str, int]], **kwargs
 ) -> TopologyConfig:

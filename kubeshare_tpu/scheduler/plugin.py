@@ -126,6 +126,14 @@ class KubeShareScheduler:
             self.register_node(node.name, healthy=node.is_healthy())
         elif event == "delete":
             self.allocator.set_node_status(node.name, False)
+            # drop score-cache entries for the departed node: keyed by
+            # (node, model, kind), they would otherwise accumulate forever
+            # under node churn (ADVICE r3)
+            self._node_score_cache = {
+                key: value
+                for key, value in self._node_score_cache.items()
+                if key[0] != node.name
+            }
 
     def _on_pod_event(self, event: str, obj: object) -> None:
         pod = obj
@@ -632,9 +640,18 @@ class KubeShareScheduler:
         status.uuid = ",".join(uuids)
         status.model = ",".join(models)
 
+        from ..cell.topology import chip_box
+
         env = {
             constants.ENV_VISIBLE_CHIPS: self._chip_indices(status.cells),
             constants.ENV_POD_NAME: pod.key,
+            # multi-chip visibility contract (SURVEY §7.2): the pod's runtime
+            # initializes over exactly its granted sub-mesh.  A solo pod is
+            # one process; _gang_env overrides the process grid for gangs.
+            constants.ENV_PROCESS_BOUNDS: "1,1,1",
+            constants.ENV_CHIPS_PER_PROCESS_BOUNDS: chip_box(
+                [cell.coords for cell in status.cells], len(status.cells)
+            ),
         }
         env.update(self._gang_env(pod, status))
         for container in assumed.containers:
@@ -667,6 +684,16 @@ class KubeShareScheduler:
             ENV_GANG_NAME: status.pod_group,
             ENV_GANG_SIZE: str(size),
             ENV_GANG_RANK: str(rank),
+            # each gang member is one process in a linear process grid.
+            # libtpu requires chips-per-process bounds to be UNIFORM across
+            # the slice's processes, and members bind at different times
+            # (later members' coords are unknown here) — so every member
+            # gets the coord-free linear box over its chip COUNT, which
+            # agrees across a homogeneous gang by construction; the
+            # coord-shaped box is solo-pod only (SURVEY §7.2).
+            constants.ENV_PROCESS_BOUNDS: f"{size},1,1",
+            constants.ENV_CHIPS_PER_PROCESS_BOUNDS:
+                f"{max(len(status.cells), 1)},1,1",
         }
 
     # ------------------------------------------------------------------
