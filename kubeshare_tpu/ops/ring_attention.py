@@ -427,6 +427,18 @@ def zigzag_permutation(seq_len: int, sp: int):
     return perm, inv
 
 
+# traced calls of the zigzag wrapper (misuse visibility; see
+# ring_attention_sharded)
+_zigzag_traced_calls = 0
+
+
+def zigzag_traced_calls() -> int:
+    """How many times ring_attention_sharded(layout='zigzag') has been
+    traced in this process — >1 usually means a model is paying the
+    wrapper's two global permutations per layer."""
+    return _zigzag_traced_calls
+
+
 def zigzag_shard(x: jax.Array, sp: int, axis: int = 2) -> jax.Array:
     """Permute a contiguous global sequence axis into zigzag order (apply
     OUTSIDE shard_map, before sequence-sharding over sp)."""
@@ -719,6 +731,22 @@ def ring_attention_sharded(
         raise ValueError(f"unknown ring layout {layout!r}")
     if layout == "zigzag" and not causal:
         raise ValueError("zigzag layout only balances causal attention")
+    if layout == "zigzag" and isinstance(q, jax.core.Tracer):
+        # each wrapper call pays two global permutations (shard + unshard);
+        # a multi-layer model calling it per layer turns that into a
+        # per-layer all-to-all.  Count traced calls so the misuse is
+        # visible (ADVICE r3); the permute-once path is in the docstring.
+        global _zigzag_traced_calls
+        _zigzag_traced_calls += 1
+        if _zigzag_traced_calls == 2:
+            from ..utils.logger import get_logger
+
+            get_logger("kubeshare-ops").warning(
+                "ring_attention_sharded(layout='zigzag') traced more than "
+                "once in this process — every call permutes globally twice; "
+                "multi-layer models should permute once (zigzag_shard at "
+                "embedding) and call the in-shard ring entry points"
+            )
     if use_flash is None:
         use_flash = ring_flash_auto(q.shape[2], mesh, seq_axis, interpret,
                                     layout=layout)

@@ -311,6 +311,69 @@ class TestZigzagRing:
                                    batch_axis=None, head_axis=None,
                                    layout="zigzag")
 
+    def test_zigzag_balance_property(self):
+        """The load-balance claim, asserted rather than narrated (VERDICT
+        r3 #5): counting visible (unmasked) q-k pairs from the layout's own
+        position invariant (_zigzag_shard_positions — the function the
+        forward masks, backward, and RoPE all consume), every device does
+        IDENTICAL work at every ring step — exactly half the 2c x 2c block
+        off-diagonal — and per-device totals are exactly 1/sp of global
+        causal work.  Contiguous shards fail the same count."""
+        from kubeshare_tpu.ops.ring_attention import _zigzag_shard_positions
+
+        sp, c = 4, 4
+        pos = {
+            i: np.asarray(_zigzag_shard_positions(i, sp, c))
+            for i in range(sp)
+        }
+
+        def visible(qp, kp):
+            return int((qp[:, None] >= kp[None, :]).sum())
+
+        for t in range(1, sp):  # every off-diagonal ring step
+            works = [visible(pos[i], pos[(i - t) % sp]) for i in range(sp)]
+            assert len(set(works)) == 1, (t, works)
+            assert works[0] == 2 * c * c  # exactly half the block
+
+        diag = [visible(pos[i], pos[i]) for i in range(sp)]
+        assert len(set(diag)) == 1
+        s = 2 * c * sp
+        per_device_total = diag[0] + (sp - 1) * 2 * c * c
+        assert per_device_total * sp == s * (s + 1) // 2
+
+        # contiguous layout: same count is imbalanced at every off-diagonal
+        # step (some devices fully masked, others fully visible)
+        cont = {i: np.arange(i * 2 * c, (i + 1) * 2 * c) for i in range(sp)}
+        for t in range(1, sp):
+            works = {visible(cont[i], cont[(i - t) % sp]) for i in range(sp)}
+            assert len(works) > 1, t
+
+    def test_zigzag_wrapper_counts_traced_calls(self):
+        """The wrapper pays two global permutations per call; repeated
+        calls under one trace (per-layer misuse) must be visible via the
+        traced-call counter (ADVICE r3)."""
+        import importlib
+
+        # ops/__init__ re-exports a function named ring_attention, which
+        # shadows the module for `import ... as` attribute lookup
+        ra = importlib.import_module("kubeshare_tpu.ops.ring_attention")
+
+        mesh = make_mesh(MeshSpec(dp=2, tp=1, sp=4))
+        q, k, v = (rand(i, 1, 1, 16, 4) for i in range(3))
+        before = ra.zigzag_traced_calls()
+
+        @jax.jit
+        def two_layers(q, k, v):
+            o = ring_attention_sharded(q, k, v, mesh, causal=True,
+                                       batch_axis=None, head_axis=None,
+                                       use_flash=False, layout="zigzag")
+            return ring_attention_sharded(o, k, v, mesh, causal=True,
+                                          batch_axis=None, head_axis=None,
+                                          use_flash=False, layout="zigzag")
+
+        two_layers(q, k, v)
+        assert ra.zigzag_traced_calls() >= before + 2
+
 
 class TestRingFlashAttention:
     """Pallas-fused ring (VERDICT r1 #5): the flash kernel computes each
