@@ -101,7 +101,8 @@ def _decode_one(params, config: TransformerConfig, cache: Dict, token: jax.Array
                 layer["moe"], y,
                 MoEConfig(d_model=d_m, d_ff=f, num_experts=e,
                           capacity_factor=config.moe_capacity_factor,
-                          top_k=config.moe_top_k),
+                          top_k=config.moe_top_k,
+                          dispatch=config.moe_dispatch),
                 capacity=y.shape[0] * y.shape[1],
             )
             x = x + out.astype(dtype)

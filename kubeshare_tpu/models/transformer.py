@@ -47,6 +47,9 @@ class TransformerConfig:
     # "tokens_choose" (top-k) or "experts_choose" (balanced-by-
     # construction; training-time only — incremental decode refuses it)
     moe_routing: str = "tokens_choose"
+    # "scatter" (permutation dispatch, no dispatch FLOPs) or "einsum"
+    # (dense one-hot dispatch); see ops.moe
+    moe_dispatch: str = "scatter"
 
     def layer_is_moe(self, layer_idx: int) -> bool:
         return (self.moe_every is not None
@@ -163,14 +166,14 @@ def _forward(params, tokens, config, attention_fn, pos_offset,
         # rematerialize each layer's activations in the backward pass —
         # the standard HBM-for-FLOPs trade for long sequences / deep stacks
         layer_fn = jax.checkpoint(
-            _layer_forward, static_argnums=(2, 3, 5, 6, 7)
+            _layer_forward, static_argnums=(2, 3, 5, 6, 7, 8)
         )
     aux_total = jnp.float32(0.0)
     for layer in params["layers"]:
         x, aux = layer_fn(layer, x, attention_fn, dtype,
                           positions if use_rope else None,
                           config.moe_capacity_factor, config.moe_top_k,
-                          config.moe_routing)
+                          config.moe_routing, config.moe_dispatch)
         aux_total = aux_total + aux
 
     x = _rms_norm(x, params["final_norm"]["scale"])
@@ -181,7 +184,8 @@ def _forward(params, tokens, config, attention_fn, pos_offset,
 
 def _layer_forward(layer, x, attention_fn, dtype, rope_positions_or_none,
                    moe_capacity_factor: float = 1.25, moe_top_k: int = 1,
-                   moe_routing: str = "tokens_choose"):
+                   moe_routing: str = "tokens_choose",
+                   moe_dispatch: str = "scatter"):
     """One transformer layer; returns (x, aux) where aux is the MoE
     load-balancing loss (0.0 for dense-MLP layers)."""
     # attention block
@@ -204,7 +208,8 @@ def _layer_forward(layer, x, attention_fn, dtype, rope_positions_or_none,
             layer["moe"], y,
             MoEConfig(d_model=d, d_ff=f, num_experts=e,
                       capacity_factor=moe_capacity_factor,
-                      top_k=moe_top_k, routing=moe_routing),
+                      top_k=moe_top_k, routing=moe_routing,
+                      dispatch=moe_dispatch),
         )
         return x + out.astype(dtype), aux
     y = jax.nn.gelu(y @ layer["mlp"]["w_in"].astype(dtype))

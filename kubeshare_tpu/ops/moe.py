@@ -2,11 +2,21 @@
 
 Experts are sharded across devices; tokens are routed top-k (top-1 Switch
 style by default, top-2 GShard style via ``top_k=2``) and exchanged with the
-expert owners via a dense one-hot dispatch einsum whose contraction XLA
-lowers to an all-to-all over ICI when the expert axis is sharded.  Dense
-dispatch keeps everything static-shaped and MXU-friendly (no ragged
-gathers); capacity_factor bounds the per-expert buffer exactly like
-token-dropping MoE implementations.
+expert owners.  Two dispatch strategies, numerically identical:
+
+- ``"scatter"`` (default): kept token-choices scatter-add into the
+  ``[e, capacity, d]`` expert buffers and gather back out — O(k*n*d) memory
+  traffic, no dispatch FLOPs.  Slot positions are unique per expert, so the
+  scatter is a permutation (deterministic, exact-VJP gather transpose).
+- ``"einsum"``: the classic dense one-hot dispatch/combine einsums whose
+  contraction XLA lowers to an all-to-all over ICI when the expert axis is
+  sharded.  Costs O(n * e * capacity * d) ~ O(cf * k * n^2 * d) MXU FLOPs —
+  quadratic in tokens; at flagship sizes the dispatch einsums burn more
+  FLOPs than the expert FFNs themselves (the measured 37% vs 57% MFU gap,
+  VERDICT r3 #4).
+
+Both keep everything static-shaped; capacity_factor bounds the per-expert
+buffer exactly like token-dropping MoE implementations.
 """
 
 from __future__ import annotations
@@ -36,6 +46,10 @@ class MoEConfig:
     # expert's choices depend on the whole batch/sequence, so it cannot
     # be replayed token-by-token at decode)
     routing: str = "tokens_choose"
+    # "scatter" (default): permutation scatter/gather dispatch, O(k*n*d)
+    # traffic and no dispatch FLOPs.  "einsum": dense one-hot dispatch
+    # einsums, O(cf*k*n^2*d) FLOPs (see module docstring).
+    dispatch: str = "scatter"
 
 
 def moe_init(rng: jax.Array, config: MoEConfig) -> Dict:
@@ -75,6 +89,8 @@ def moe_apply(
         raise ValueError(f"top_k must be in [1, num_experts], got {k}")
     if config.routing not in ("tokens_choose", "experts_choose"):
         raise ValueError(f"unknown routing {config.routing!r}")
+    if config.dispatch not in ("scatter", "einsum"):
+        raise ValueError(f"unknown dispatch {config.dispatch!r}")
     tokens = x.reshape(b * s, d)
     n = tokens.shape[0]
     if capacity is None:
@@ -105,20 +121,26 @@ def moe_apply(
     within_capacity = (position_in_expert <= capacity) & (onehot_flat > 0)
     position = (position_in_expert - 1).max(axis=-1)  # [k*n]
 
-    # per-choice dense dispatch [k, n, e, capacity]; choices occupy
-    # disjoint slots, so summing over k gives the 0/1 input dispatch
-    dispatch_k = (
-        within_capacity[:, :, None]
-        & (jax.nn.one_hot(position, capacity, dtype=jnp.int32)[:, None, :] > 0)
-    ).astype(x.dtype).reshape(k, n, e, capacity)
-    dispatch = dispatch_k.sum(axis=0)  # [n, e, capacity]
-    # combine weights fold in the (kept-masked) per-choice gates
-    combine = jnp.einsum(
-        "kn,knec->nec", topk_gate.T.astype(x.dtype), dispatch_k
-    )
+    if config.dispatch == "scatter":
+        combined = _scatter_dispatch_combine(
+            params, tokens, topk_index, topk_gate, within_capacity,
+            position, e, capacity, x.dtype,
+        )
+    else:
+        # per-choice dense dispatch [k, n, e, capacity]; choices occupy
+        # disjoint slots, so summing over k gives the 0/1 input dispatch
+        dispatch_k = (
+            within_capacity[:, :, None]
+            & (jax.nn.one_hot(position, capacity, dtype=jnp.int32)[:, None, :] > 0)
+        ).astype(x.dtype).reshape(k, n, e, capacity)
+        dispatch = dispatch_k.sum(axis=0)  # [n, e, capacity]
+        # combine weights fold in the (kept-masked) per-choice gates
+        combine = jnp.einsum(
+            "kn,knec->nec", topk_gate.T.astype(x.dtype), dispatch_k
+        )
 
-    combined = _dispatch_experts_combine(params, tokens, dispatch, combine,
-                                         x.dtype)
+        combined = _dispatch_experts_combine(params, tokens, dispatch,
+                                             combine, x.dtype)
 
     # load-balancing auxiliary loss over first choices (Switch/GShard style)
     assignment_fraction = jnp.mean(onehot[:, 0, :].astype(jnp.float32), axis=0)
@@ -128,19 +150,49 @@ def moe_apply(
     return combined.reshape(b, s, d), aux_loss
 
 
-def _dispatch_experts_combine(params, tokens, dispatch, combine, dtype):
-    """Shared expert-FFN body: gather token buffers per expert
-    ([n, e, cap] dispatch), run every expert's MLP, and weight results
-    back per token ([n, e, cap] combine).  Both routing families differ
-    only in how dispatch/combine are built."""
-    expert_inputs = jnp.einsum("nec,nd->ecd", dispatch, tokens)  # [e, cap, d]
+def _expert_ffn(params, expert_inputs, dtype):
+    """Every expert's MLP over its [e, cap, d] token buffer — the batched
+    matmuls both dispatch strategies feed."""
     hidden = jax.nn.gelu(
         jnp.einsum("ecd,edf->ecf", expert_inputs, params["w_in"].astype(dtype))
     )
-    expert_outputs = jnp.einsum(
-        "ecf,efd->ecd", hidden, params["w_out"].astype(dtype)
-    )
+    return jnp.einsum("ecf,efd->ecd", hidden, params["w_out"].astype(dtype))
+
+
+def _dispatch_experts_combine(params, tokens, dispatch, combine, dtype):
+    """Dense-einsum dispatch body: gather token buffers per expert
+    ([n, e, cap] dispatch), run every expert's MLP, and weight results
+    back per token ([n, e, cap] combine)."""
+    expert_inputs = jnp.einsum("nec,nd->ecd", dispatch, tokens)  # [e, cap, d]
+    expert_outputs = _expert_ffn(params, expert_inputs, dtype)
     return jnp.einsum("nec,ecd->nd", combine, expert_outputs)
+
+
+def _scatter_dispatch_combine(params, tokens, topk_index, topk_gate,
+                              within_capacity, position, e, capacity, dtype):
+    """Permutation dispatch: every kept (token, choice) owns a unique
+    (expert, position) buffer slot, so dispatch is a scatter-add that
+    never collides (deterministic) and combine is a plain gather — the
+    whole exchange is O(k*n*d) memory traffic with zero matmul FLOPs,
+    against the dense path's O(n * e * cap * d) einsums (VERDICT r3 #4).
+    Dropped choices route to a sentinel row that is sliced off."""
+    n, d = tokens.shape
+    k = topk_index.shape[1]
+    # choice-rank-major flat order, matching position's cumsum order
+    flat_expert = topk_index.T.reshape(k * n)
+    keep = within_capacity.any(axis=-1)  # [k*n]
+    slot = jnp.where(keep, flat_expert * capacity + position, e * capacity)
+    token_idx = jnp.tile(jnp.arange(n), k)
+    buf = jnp.zeros((e * capacity + 1, d), dtype)
+    buf = buf.at[slot].add(tokens[token_idx])
+    expert_outputs = _expert_ffn(params, buf[:-1].reshape(e, capacity, d),
+                                 dtype)
+    flat_out = jnp.concatenate(
+        [expert_outputs.reshape(e * capacity, d), jnp.zeros((1, d), dtype)]
+    )
+    gates = topk_gate.T.reshape(k * n).astype(dtype) * keep.astype(dtype)
+    picked = flat_out[slot] * gates[:, None]  # [k*n, d]
+    return picked.reshape(k, n, d).sum(axis=0)
 
 
 def _experts_choose(params, x, tokens, probs, config, capacity):
@@ -155,17 +207,28 @@ def _experts_choose(params, x, tokens, probs, config, capacity):
     n = tokens.shape[0]
 
     gates, picks = jax.lax.top_k(probs.T, capacity)  # [e, capacity]
-    # dense dispatch [n, e, capacity]: slot c of expert j holds token
-    # picks[j, c]
-    dispatch = (
-        jax.nn.one_hot(picks, n, dtype=jnp.int32)  # [e, cap, n]
-        .transpose(2, 0, 1)
-        .astype(x.dtype)
-    )
-    combine = dispatch * gates.astype(x.dtype)[None, :, :]
+    if config.dispatch == "scatter":
+        # picks IS the dispatch: buffer slot (j, c) holds token picks[j, c]
+        # — dispatch is a gather, combine a scatter-add back per token
+        expert_outputs = _expert_ffn(params, tokens[picks], x.dtype)
+        weighted = expert_outputs * gates.astype(x.dtype)[..., None]
+        combined = (
+            jnp.zeros_like(tokens)
+            .at[picks.reshape(-1)]
+            .add(weighted.reshape(e * capacity, d))
+        )
+    else:
+        # dense dispatch [n, e, capacity]: slot c of expert j holds token
+        # picks[j, c]
+        dispatch = (
+            jax.nn.one_hot(picks, n, dtype=jnp.int32)  # [e, cap, n]
+            .transpose(2, 0, 1)
+            .astype(x.dtype)
+        )
+        combine = dispatch * gates.astype(x.dtype)[None, :, :]
 
-    combined = _dispatch_experts_combine(params, tokens, dispatch, combine,
-                                         x.dtype)
+        combined = _dispatch_experts_combine(params, tokens, dispatch,
+                                             combine, x.dtype)
     return combined.reshape(b, s, d), jnp.float32(0.0)
 
 

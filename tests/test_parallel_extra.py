@@ -108,6 +108,51 @@ class TestMoE:
         np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
                                    rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.parametrize("top_k", [1, 2])
+    @pytest.mark.parametrize("routing", ["tokens_choose", "experts_choose"])
+    def test_scatter_dispatch_matches_einsum(self, top_k, routing):
+        """The permutation (scatter/gather) dispatch is the same math as
+        the dense one-hot einsums — including under capacity overflow,
+        where both must drop the same weakest choices (VERDICT r3 #4)."""
+        for capacity in (None, 3):  # derived (no drops) and overflowing
+            kwargs = dict(d_model=16, d_ff=32, num_experts=4, top_k=top_k,
+                          routing=routing, capacity_factor=1.5)
+            params = moe_init(jax.random.PRNGKey(0), MoEConfig(**kwargs))
+            x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16))
+            out_s, aux_s = moe_apply(
+                params, x, MoEConfig(dispatch="scatter", **kwargs),
+                capacity=capacity)
+            out_e, aux_e = moe_apply(
+                params, x, MoEConfig(dispatch="einsum", **kwargs),
+                capacity=capacity)
+            np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_e),
+                                       rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(float(aux_s), float(aux_e), rtol=1e-6)
+
+    def test_scatter_dispatch_grads_match_einsum(self):
+        config_kwargs = dict(d_model=8, d_ff=16, num_experts=4, top_k=2,
+                             capacity_factor=1.25)
+        params = moe_init(jax.random.PRNGKey(0), MoEConfig(**config_kwargs))
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, 16, 8))
+
+        def loss(p, dispatch):
+            out, aux = moe_apply(
+                p, x, MoEConfig(dispatch=dispatch, **config_kwargs))
+            return jnp.mean(out ** 2) + 0.01 * aux
+
+        g_s = jax.grad(lambda p: loss(p, "scatter"))(params)
+        g_e = jax.grad(lambda p: loss(p, "einsum"))(params)
+        for name in ("router", "w_in", "w_out"):
+            np.testing.assert_allclose(np.asarray(g_s[name]),
+                                       np.asarray(g_e[name]),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_unknown_dispatch_rejected(self):
+        config = MoEConfig(d_model=4, d_ff=8, num_experts=2, dispatch="bogus")
+        params = moe_init(jax.random.PRNGKey(0), config)
+        with pytest.raises(ValueError, match="dispatch"):
+            moe_apply(params, jnp.zeros((1, 2, 4)), config)
+
     @pytest.mark.parametrize("bad_k", [0, -1, 5])
     def test_top_k_validated(self, bad_k):
         config = MoEConfig(d_model=4, d_ff=8, num_experts=4, top_k=bad_k)
