@@ -216,12 +216,11 @@ class Watch:
             yield {"type": event_type, "object": obj}
 
 
-def install(monkeypatch, store: Optional[FakeStore] = None) -> FakeStore:
-    """Register the fake under sys.modules so `import kubernetes` (and the
-    `from kubernetes import client, config, watch` in the adapter) resolves
-    here.  Returns the backing store for state/fault manipulation."""
-    store = store or FakeStore()
-
+def build_modules(store: FakeStore):
+    """Build the (kubernetes, client, config, watch) module objects over a
+    store.  Shared by install() (sys.modules patching for in-process tests)
+    and the packaging smoke's installable `kubernetes` distribution, whose
+    __init__ binds these to a default store (test_packaging.py)."""
     client_mod = types.ModuleType("kubernetes.client")
     client_mod.ApiException = ApiException
     client_mod.CoreV1Api = lambda: CoreV1Api(store)
@@ -246,6 +245,15 @@ def install(monkeypatch, store: Optional[FakeStore] = None) -> FakeStore:
     kubernetes_mod.client = client_mod
     kubernetes_mod.config = config_mod
     kubernetes_mod.watch = watch_mod
+    return kubernetes_mod, client_mod, config_mod, watch_mod
+
+
+def install(monkeypatch, store: Optional[FakeStore] = None) -> FakeStore:
+    """Register the fake under sys.modules so `import kubernetes` (and the
+    `from kubernetes import client, config, watch` in the adapter) resolves
+    here.  Returns the backing store for state/fault manipulation."""
+    store = store or FakeStore()
+    kubernetes_mod, client_mod, config_mod, watch_mod = build_modules(store)
 
     monkeypatch.setitem(__import__("sys").modules, "kubernetes", kubernetes_mod)
     monkeypatch.setitem(__import__("sys").modules, "kubernetes.client", client_mod)
