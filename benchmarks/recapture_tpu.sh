@@ -1,0 +1,86 @@
+#!/usr/bin/env bash
+# Round-4 TPU recapture runbook (VERDICT r3 #1): run the moment the
+# accelerator tunnel is back.  One command, wedge-safe ordering — a
+# single-process probe gates everything, phases are spaced, and each
+# artifact lands in benchmarks/out/ for perf.md + the round record.
+#
+#   bash benchmarks/recapture_tpu.sh [outdir]
+#
+# Produces (all JSON-lines):
+#   out/probe.txt            device probe result
+#   out/bench_train.json     cooperative + adversarial north star
+#   out/bench_serve.json     fractional-serving ratio + p50/p95
+#   out/kernel_fwd.json      3x fwd repeats (median harness) incl (1,4,8192,128)
+#   out/kernel_fwdbwd.json   re-measured fwd+bwd table (replaces min()-era rows)
+#   out/kernel_window.json   re-measured sliding-window headline
+#   out/kernel_model.json    flagship/wide/moe MFU
+#   out/kernel_moe.json      MoE dispatch einsum-vs-scatter MFU
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-benchmarks/out}"
+mkdir -p "$OUT"
+# fwd repeats append across the loop: truncate up front so a rerun never
+# mixes rows from an earlier (possibly aborted) capture session
+: > "$OUT/kernel_fwd.json"
+: > "$OUT/kernel_fwd.log"
+
+gap() { sleep 30; }
+
+probe() {
+  # single-process reachability check; a wedge presents as device init
+  # hanging, so a hard timeout IS the detection
+  timeout 120 python -c "import jax; print(jax.devices())" \
+      > "$OUT/probe.txt" 2>&1
+}
+
+# run <budget_s> <label> <outfile> <cmd...>: every phase gets a hard
+# timeout — a mid-run wedge (bursts are the known trigger) must abort the
+# script with partial artifacts, not hang it for hours
+run() {
+  budget="$1"; label="$2"; outfile="$3"; shift 3
+  echo "== $label =="
+  if ! timeout "$budget" "$@" >> "$outfile" 2>> "${outfile%.json}.log"; then
+    echo "PHASE '$label' failed or hung (budget ${budget}s) — tunnel "
+    echo "likely wedged mid-run; artifacts so far are in $OUT"
+    exit 1
+  fi
+  tail -1 "$outfile"
+}
+
+echo "== pre-flight probe =="
+if ! probe; then
+  echo "probe failed/hung — tunnel still wedged; aborting (no burst spawned)"
+  cat "$OUT/probe.txt"
+  exit 1
+fi
+cat "$OUT/probe.txt"
+gap
+
+run 1800 "north star (cooperative + adversarial)" "$OUT/bench_train.json" \
+    python bench.py
+gap
+run 1800 "fractional serving" "$OUT/bench_serve.json" \
+    python bench.py --suite serve
+gap
+
+for i in 1 2 3; do
+  run 1200 "kernel fwd repeat $i/3 (median harness)" "$OUT/kernel_fwd.json" \
+      python benchmarks/kernel_bench.py --suite fwd
+  gap
+done
+
+run 1800 "kernel fwd+bwd (replaces the min()-era table)" \
+    "$OUT/kernel_fwdbwd.json" \
+    python benchmarks/kernel_bench.py --suite fwdbwd
+gap
+run 1200 "sliding window (replaces the min()-era 5.1x headline)" \
+    "$OUT/kernel_window.json" \
+    python benchmarks/kernel_bench.py --suite window
+gap
+run 1800 "whole-model MFU" "$OUT/kernel_model.json" \
+    python benchmarks/kernel_bench.py --suite model
+gap
+run 1800 "MoE dispatch MFU (einsum vs scatter)" "$OUT/kernel_moe.json" \
+    python benchmarks/kernel_bench.py --suite moe
+
+echo "== done; update docs/perf.md from $OUT =="
