@@ -497,18 +497,22 @@ def _flash_backward(
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention(q, k, v, causal, block_q, interpret, window=None):
-    out, _ = _flash_forward(q, k, v, causal, block_q, interpret, window=window)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention(q, k, v, causal, block_q, interpret, window=None,
+                     block_k=1024):
+    out, _ = _flash_forward(q, k, v, causal, block_q, interpret,
+                            window=window, block_k=block_k)
     return out
 
 
-def _flash_fwd(q, k, v, causal, block_q, interpret, window=None):
-    out, lse = _flash_forward(q, k, v, causal, block_q, interpret, window=window)
+def _flash_fwd(q, k, v, causal, block_q, interpret, window=None,
+               block_k=1024):
+    out, lse = _flash_forward(q, k, v, causal, block_q, interpret,
+                              window=window, block_k=block_k)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, block_q, interpret, window, residuals, g):
+def _flash_bwd(causal, block_q, interpret, window, block_k, residuals, g):
     q, k, v, out, lse = residuals
     s = q.shape[2]
     bwd_bq = min(256, s)
@@ -529,15 +533,28 @@ def _flash_bwd(causal, block_q, interpret, window, residuals, g):
 _flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
+def default_blocks(s: int) -> tuple:
+    """Forward (block_q, block_k) by sequence length, from the v5e block
+    sweep under the median harness (docs/perf.md): (512, 1024) wins
+    through mid lengths; at s >= 8192 the larger (1024, 2048) tiles cut
+    grid overhead ~10% (0.84 ms vs 0.93 ms at (1,4,8192,128)).  Only
+    sequences that tile the larger blocks take them — an untiled pick
+    would silently demote the call to the XLA reference fallback."""
+    if s >= 8192 and s % 2048 == 0:
+        return 1024, 2048
+    return 512, 1024
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     causal: bool = True,
-    block_q: int = 512,
+    block_q: Optional[int] = None,
     use_pallas: Optional[bool] = None,
     interpret: bool = False,
     window: Optional[int] = None,
+    block_k: Optional[int] = None,
 ) -> jax.Array:
     """Attention with the Pallas TPU kernel when it wins.
 
@@ -549,6 +566,9 @@ def flash_attention(
     (measured 1.2-1.9x over the XLA reference on v5e, growing with sequence
     length — docs/perf.md), the XLA reference otherwise (short sequences and
     non-TPU backends; CPU tests can force the kernel with ``interpret=True``).
+
+    ``block_q``/``block_k`` default by sequence length
+    (:func:`default_blocks`); pass explicitly to override.
     """
     if window is not None and window <= 0:
         raise ValueError(f"window must be positive, got {window}")
@@ -558,7 +578,9 @@ def flash_attention(
         )
     if not use_pallas:
         return attention_reference(q, k, v, causal, window)
-    return _flash_attention(q, k, v, causal, block_q, interpret, window)
+    auto_bq, auto_bk = default_blocks(q.shape[2])
+    return _flash_attention(q, k, v, causal, block_q or auto_bq, interpret,
+                            window, block_k or auto_bk)
 
 
 # ---------------------------------------------------------------------------

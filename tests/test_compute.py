@@ -74,6 +74,17 @@ class TestAttention:
         ref = attention_reference(q, k, v)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
 
+    def test_default_blocks_by_seq_len(self):
+        """Seq-dependent kernel tiles (v5e sweep, docs/perf.md): larger
+        blocks only at s >= 8192 AND only when they tile — an untiled
+        pick would silently demote the call to the XLA reference."""
+        from kubeshare_tpu.ops.attention import default_blocks
+
+        assert default_blocks(2048) == (512, 1024)
+        assert default_blocks(8192) == (1024, 2048)
+        assert default_blocks(16384) == (1024, 2048)
+        assert default_blocks(9216) == (512, 1024)  # 9216 % 2048 != 0
+
 
 class TestBlockSparseAttention:
     """Arbitrary [n_qblocks, n_kblocks] masks over the flash kernels
