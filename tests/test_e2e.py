@@ -157,3 +157,5 @@ def test_full_slice(tmp_path):
         # chip share reclaimed in the allocator too
         leaf = plugin.allocator.leaf_cells[chip_uuid]
         assert leaf.available == 0.5
+
+
