@@ -284,9 +284,11 @@ def worker_decode_main(args: argparse.Namespace) -> None:
             max_seq_len=128, positional="rope")
         batch, prompt_len, new_tokens = 2, 8, 8
     else:
+        # GQA (2 KV heads under 8 query heads): the serving-shaped config —
+        # the KV cache, decode's dominant HBM cost, shrinks 4x
         config = TransformerConfig(
-            d_model=512, n_layers=8, n_heads=8, d_ff=2048, vocab_size=32000,
-            max_seq_len=512, positional="rope")
+            d_model=512, n_layers=8, n_heads=8, n_kv_heads=2, d_ff=2048,
+            vocab_size=32000, max_seq_len=512, positional="rope")
         batch, prompt_len, new_tokens = 4, 64, 64
 
     params = transformer_init(jax.random.PRNGKey(0), config)

@@ -18,8 +18,11 @@ from .transformer import TransformerConfig, _rms_norm
 
 
 def init_kv_cache(config: TransformerConfig, batch: int) -> Dict:
-    """Static [layers x batch x heads x max_seq x head_dim] cache."""
-    shape = (batch, config.n_heads, config.max_seq_len, config.head_dim)
+    """Static [layers x batch x kv_heads x max_seq x head_dim] cache.
+
+    Under GQA (``n_kv_heads < n_heads``) the cache — decode's dominant
+    HBM cost — shrinks by the query-group factor."""
+    shape = (batch, config.kv_heads, config.max_seq_len, config.head_dim)
     return {
         "k": jnp.zeros((config.n_layers, *shape), config.dtype),
         "v": jnp.zeros((config.n_layers, *shape), config.dtype),
@@ -28,22 +31,33 @@ def init_kv_cache(config: TransformerConfig, batch: int) -> Dict:
 
 
 def _attend_cached(q, cache_k, cache_v, length, window=None):
-    """q: [b,h,1,d] against cache [b,h,S,d]; positions >= length masked.
+    """q: [b,h,1,d] against cache [b,h_kv,S,d]; positions >= length masked.
+
+    GQA: when h > h_kv the query heads are grouped over the shared KV
+    heads ([b, h_kv, g, 1, d] x [b, h_kv, S, d]) — no KV repetition is
+    materialized, so the einsum reads each cached key/value once.
 
     With sliding-window attention the query sits at position ``length - 1``
     and may only see keys where ``q_pos - k_pos < window``, i.e. positions
     ``>= length - window`` — the same band transformer_apply's dense mask
     keeps (ops/attention.py window semantics).
     """
-    scale = q.shape[-1] ** -0.5
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, cache_k).astype(jnp.float32) * scale
+    b, h, _, d = q.shape
+    h_kv = cache_k.shape[1]
+    group = h // h_kv
+    scale = d ** -0.5
+    qg = q.reshape(b, h_kv, group, q.shape[2], d)
+    scores = jnp.einsum(
+        "bhgqd,bhkd->bhgqk", qg, cache_k).astype(jnp.float32) * scale
     positions = jnp.arange(cache_k.shape[2])
-    valid = positions[None, None, None, :] < length
+    valid = positions[None, None, None, None, :] < length
     if window is not None:
-        valid = valid & (positions[None, None, None, :] >= length - window)
+        valid = valid & (
+            positions[None, None, None, None, :] >= length - window)
     scores = jnp.where(valid, scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1).astype(cache_v.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs, cache_v)
+    out = jnp.einsum("bhgqk,bhkd->bhgqd", probs, cache_v)
+    return out.reshape(b, h, q.shape[2], d)
 
 
 def _decode_one(params, config: TransformerConfig, cache: Dict, token: jax.Array):

@@ -36,6 +36,11 @@ class TransformerConfig:
     dtype: jnp.dtype = jnp.bfloat16
     attention: str = "auto"  # auto | reference | flash | ring
     attention_window: Optional[int] = None  # sliding-window (local) size
+    # grouped-query attention: KV heads shared by query-head groups
+    # (None = n_heads, plain MHA; 1 = MQA).  Shrinks the decode KV cache
+    # and its HBM traffic by n_heads/n_kv_heads — the ops (flash, ring,
+    # ulysses, the hand-scheduled backwards) are already GQA-aware.
+    n_kv_heads: Optional[int] = None
     positional: str = "learned"  # learned | rope
     remat: bool = False  # jax.checkpoint each layer (HBM for FLOPs)
     # MoE: every Nth layer's MLP becomes a top-k-routed expert mixture
@@ -59,13 +64,26 @@ class TransformerConfig:
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
 
+    @property
+    def kv_heads(self) -> int:
+        """KV head count: n_kv_heads (GQA/MQA) or n_heads (MHA)."""
+        return self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+
 
 def transformer_init(rng: jax.Array, config: TransformerConfig) -> Dict:
     if config.moe_every is not None and config.moe_every < 1:
         raise ValueError(f"moe_every must be >= 1, got {config.moe_every}")
+    if config.kv_heads < 1:
+        raise ValueError(f"n_kv_heads must be >= 1, got {config.kv_heads}")
+    if config.n_heads % config.kv_heads != 0:
+        raise ValueError(
+            f"n_heads ({config.n_heads}) must be a multiple of n_kv_heads "
+            f"({config.kv_heads})"
+        )
     n = 4 + 7 * config.n_layers
     keys = iter(jax.random.split(rng, n))
     d, h, f = config.d_model, config.n_heads, config.d_ff
+    h_kv = config.kv_heads
     hd = config.head_dim
 
     def dense(key, shape, fan_in):
@@ -89,8 +107,8 @@ def transformer_init(rng: jax.Array, config: TransformerConfig) -> Dict:
         layer = {
             "attn": {
                 "wq": dense(next(keys), (d, h, hd), d),
-                "wk": dense(next(keys), (d, h, hd), d),
-                "wv": dense(next(keys), (d, h, hd), d),
+                "wk": dense(next(keys), (d, h_kv, hd), d),
+                "wv": dense(next(keys), (d, h_kv, hd), d),
                 "wo": dense(next(keys), (h, hd, d), d),
             },
             "norm1": {"scale": jnp.ones((d,))},
@@ -267,10 +285,14 @@ def _validate_sp_entry(
             "visibility there is ring-position-dependent); use "
             "attention='ulysses', which composes with windows"
         )
-    if strategy == "ulysses" and config.n_heads % mesh.shape[seq_axis] != 0:
+    if strategy == "ulysses" and (
+        config.n_heads % mesh.shape[seq_axis] != 0
+        or config.kv_heads % mesh.shape[seq_axis] != 0
+    ):
         raise ValueError(
-            f"attention='ulysses' needs n_heads ({config.n_heads}) divisible "
-            f"by the {seq_axis!r} mesh degree ({mesh.shape[seq_axis]})"
+            f"attention='ulysses' needs n_heads ({config.n_heads}) and "
+            f"n_kv_heads ({config.kv_heads}) divisible by the "
+            f"{seq_axis!r} mesh degree ({mesh.shape[seq_axis]})"
         )
     if config.moe_every is not None:
         raise ValueError(

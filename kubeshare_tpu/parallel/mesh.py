@@ -102,6 +102,22 @@ def shard_params(params, rules: Dict[str, P], mesh: Mesh):
     def place(path: str, x):
         for needle, spec in ordered:
             if needle in path:
+                # name the parameter and axis up front: device_put's raw
+                # divisibility error says neither (e.g. a GQA config whose
+                # shrunken wk/wv head axis no longer divides tp)
+                for dim, axes in enumerate(spec):
+                    if axes is None:
+                        continue
+                    names = axes if isinstance(axes, tuple) else (axes,)
+                    degree = 1
+                    for name in names:
+                        degree *= mesh.shape[name]
+                    if x.shape[dim] % degree != 0:
+                        raise ValueError(
+                            f"cannot shard {path}: axis {dim} (size "
+                            f"{x.shape[dim]}) does not divide mesh "
+                            f"{'x'.join(names)}={degree}"
+                        )
                 return jax.device_put(x, NamedSharding(mesh, spec))
         return jax.device_put(x, replicated(mesh))
 

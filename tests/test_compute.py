@@ -598,6 +598,25 @@ class TestRingTransformer:
         np.testing.assert_allclose(np.asarray(dense), np.asarray(ring),
                                    rtol=2e-4, atol=2e-4)
 
+    def test_gqa_ring_forward_matches_dense(self):
+        """A GQA model (2 KV heads under 4 query heads) through the
+        sequence-parallel ring must match its own dense forward — the
+        model-level closure of the op-level GQA ring tests."""
+        from kubeshare_tpu.models.transformer import transformer_apply_ring
+
+        mesh = make_mesh(MeshSpec(dp=2, tp=1, sp=4))
+        config = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_kv_heads=2, n_layers=2,
+            d_ff=64, max_seq_len=64, dtype=jnp.float32,
+            attention="reference", positional="rope",
+        )
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 64)
+        dense = transformer_apply(params, tokens, config)
+        ring = transformer_apply_ring(params, tokens, config, mesh)
+        np.testing.assert_allclose(np.asarray(dense), np.asarray(ring),
+                                   rtol=2e-4, atol=2e-4)
+
     def test_ring_flash_forward_matches_dense(self):
         """Model-level: the Pallas-fused ring body (interpret mode) must
         reproduce the dense forward bit-for-tolerance."""
@@ -903,6 +922,41 @@ class TestDecoding:
             rtol=2e-4, atol=2e-4,
         )
 
+    def test_gqa_incremental_matches_full_forward(self):
+        """GQA decode: the grouped cached-attention path (KV cache holds
+        n_kv_heads, query heads grouped over it with no materialized
+        repetition) must equal the dense GQA forward."""
+        from kubeshare_tpu.models.decoding import init_kv_cache, prefill
+        from kubeshare_tpu.models.transformer import (
+            TransformerConfig, transformer_init)
+
+        config = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_kv_heads=2, n_layers=2,
+            d_ff=64, max_seq_len=32, dtype=jnp.float32,
+            attention="reference", positional="rope",
+        )
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        # the cache — decode's dominant HBM cost — holds kv heads only
+        assert init_kv_cache(config, 2)["k"].shape == (2, 2, 2, 32, 8)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0, 64)
+        dense = transformer_apply(params, prompt, config)
+        _, last_logits = prefill(params, config, prompt)
+        np.testing.assert_allclose(
+            np.asarray(dense[:, -1]), np.asarray(last_logits),
+            rtol=2e-4, atol=2e-4,
+        )
+
+    def test_gqa_head_count_validated(self):
+        from kubeshare_tpu.models.transformer import (
+            TransformerConfig, transformer_init)
+
+        config = TransformerConfig(
+            vocab_size=8, d_model=24, n_heads=3, n_kv_heads=2, n_layers=1,
+            d_ff=8, max_seq_len=8,
+        )
+        with pytest.raises(ValueError, match="multiple of n_kv_heads"):
+            transformer_init(jax.random.PRNGKey(0), config)
+
     def test_greedy_decode_jits_and_is_deterministic(self):
         from kubeshare_tpu.models.decoding import greedy_decode
 
@@ -991,6 +1045,45 @@ class TestShardedDecoding:
             p, config, t, r, 6, temperature=0.8, top_k=10))(
                 placed, prompt, rng)
         np.testing.assert_array_equal(np.asarray(base), np.asarray(sharded))
+
+    def test_gqa_tp_sharded_greedy_matches_unsharded(self):
+        """The advertised combination — tp-sharded serving WITH a
+        kv_heads-sized cache axis — decoded under placement."""
+        from kubeshare_tpu.models.decoding import greedy_decode
+        from kubeshare_tpu.models.transformer import (
+            TransformerConfig, transformer_init, transformer_sharding_rules)
+        from kubeshare_tpu.parallel.mesh import shard_params
+
+        config = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_kv_heads=2, n_layers=2,
+            d_ff=64, max_seq_len=32, dtype=jnp.float32,
+            attention="reference",
+        )
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        mesh = make_mesh(MeshSpec(dp=2, tp=2, sp=2))
+        placed = shard_params(params, transformer_sharding_rules(), mesh)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 4), 0, 64)
+        base = greedy_decode(params, config, prompt, 8)
+        sharded = jax.jit(
+            lambda p, t: greedy_decode(p, config, t, 8))(placed, prompt)
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(sharded))
+
+    def test_undivisible_tp_names_the_parameter(self):
+        """A GQA config whose shrunken wk/wv head axis no longer divides
+        tp must fail with the parameter path and axis named, not
+        device_put's raw divisibility error."""
+        from kubeshare_tpu.models.transformer import (
+            TransformerConfig, transformer_init, transformer_sharding_rules)
+        from kubeshare_tpu.parallel.mesh import shard_params
+
+        config = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_kv_heads=1, n_layers=1,
+            d_ff=64, max_seq_len=32, dtype=jnp.float32,
+        )
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        mesh = make_mesh(MeshSpec(dp=2, tp=2, sp=2))
+        with pytest.raises(ValueError, match=r"wk.*axis 1.*tp=2"):
+            shard_params(params, transformer_sharding_rules(), mesh)
 
 
 class TestSampledDecoding:
