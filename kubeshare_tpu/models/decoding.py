@@ -17,6 +17,28 @@ from ..ops.rope import apply_rope
 from .transformer import TransformerConfig, _rms_norm
 
 
+def _check_moe_decodable(config: TransformerConfig) -> None:
+    """The routing contract every cached path shares (decode step and
+    both prefills)."""
+    if config.moe_routing == "experts_choose":
+        raise ValueError(
+            "expert-choice routing cannot be replayed token-by-token (an "
+            "expert's choices depend on the whole sequence); decode "
+            "requires moe_routing='tokens_choose'"
+        )
+    if config.moe_routing != "tokens_choose":
+        raise ValueError(f"unknown moe_routing {config.moe_routing!r}")
+
+
+def _check_prompt_fits(config: TransformerConfig, prompt_len: int) -> None:
+    if prompt_len > config.max_seq_len:
+        # dynamic_update_slice would silently clamp at the window edge
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds max_seq_len "
+            f"{config.max_seq_len}"
+        )
+
+
 def init_kv_cache(config: TransformerConfig, batch: int) -> Dict:
     """Static [layers x batch x kv_heads x max_seq x head_dim] cache.
 
@@ -101,15 +123,7 @@ def _decode_one(params, config: TransformerConfig, cache: Dict, token: jax.Array
             # stays tiny.
             from ..ops.moe import MoEConfig, moe_apply
 
-            if config.moe_routing == "experts_choose":
-                raise ValueError(
-                    "expert-choice routing cannot be replayed token-by-"
-                    "token (an expert's choices depend on the whole "
-                    "sequence); decode requires moe_routing='tokens_choose'"
-                )
-            if config.moe_routing != "tokens_choose":
-                raise ValueError(
-                    f"unknown moe_routing {config.moe_routing!r}")
+            _check_moe_decodable(config)
             e, d_m, f = layer["moe"]["w_in"].shape
             out, _ = moe_apply(
                 layer["moe"], y,
@@ -136,13 +150,49 @@ def _decode_one(params, config: TransformerConfig, cache: Dict, token: jax.Array
 
 def prefill(params, config: TransformerConfig, prompt: jax.Array) -> Tuple[Dict, jax.Array]:
     """Feed the prompt [batch, prompt_len] through the cache; returns
-    (cache, last_logits)."""
+    (cache, last_logits).
+
+    Runs as ONE dense forward pass (flash kernel and all) that also
+    collects every layer's roped K/V projections and writes them into
+    the cache in bulk — not a token-at-a-time scan, whose [b, 1, d]
+    matmuls leave the MXU idle and serialize prompt_len dispatches.
+    The incremental variant survives as :func:`prefill_incremental`
+    (the equivalence oracle, and the path for ring/ulysses configs
+    whose dense entry is sequence-sharded)."""
+    from .transformer import _forward, _select_attention
+
     batch, prompt_len = prompt.shape
-    if prompt_len > config.max_seq_len:
-        # dynamic_update_slice would silently clamp at the window edge
-        raise ValueError(
-            f"prompt length {prompt_len} exceeds max_seq_len {config.max_seq_len}"
-        )
+    _check_prompt_fits(config, prompt_len)
+    # same refusal as the decode step: the cache this prefill feeds could
+    # never be decoded from anyway
+    _check_moe_decodable(config)
+    if config.attention in ("ring", "ulysses"):
+        return prefill_incremental(params, config, prompt)
+    kv_sink: list = []
+    hidden, _ = _forward(params, prompt, config, _select_attention(config),
+                         0, apply_head=False, kv_sink=kv_sink)
+    cache = init_kv_cache(config, batch)
+    k_all = jnp.stack([k for k, _ in kv_sink]).astype(config.dtype)
+    v_all = jnp.stack([v for _, v in kv_sink]).astype(config.dtype)
+    cache["k"] = jax.lax.dynamic_update_slice(
+        cache["k"], k_all, (0, 0, 0, 0, 0))
+    cache["v"] = jax.lax.dynamic_update_slice(
+        cache["v"], v_all, (0, 0, 0, 0, 0))
+    cache["length"] = jnp.asarray(prompt_len, jnp.int32)
+    last_logits = (
+        hidden[:, -1] @ params["lm_head"].astype(config.dtype)
+    ).astype(jnp.float32)
+    return cache, last_logits
+
+
+def prefill_incremental(
+    params, config: TransformerConfig, prompt: jax.Array
+) -> Tuple[Dict, jax.Array]:
+    """Token-at-a-time prefill via the decode step (the original path):
+    the equivalence oracle for the bulk prefill, and the fallback for
+    configs whose dense forward cannot run here."""
+    batch, prompt_len = prompt.shape
+    _check_prompt_fits(config, prompt_len)
     cache = init_kv_cache(config, batch)
 
     def step(cache, token):

@@ -154,13 +154,16 @@ def _select_attention(config: TransformerConfig):
 
 
 def _forward(params, tokens, config, attention_fn, pos_offset,
-             apply_head: bool = True):
+             apply_head: bool = True, kv_sink=None):
     """Shared forward body.  ``pos_offset`` supports sequence-sharded
     callers: a scalar offset for contiguous shards, or a [seq] array of
     global token positions for permuted layouts (the zigzag ring).
     ``apply_head=False`` returns the final-normed hidden states instead
     of logits (permuted-layout callers un-permute at hidden width and
-    project outside — the logits would be vocab/d_model times wider)."""
+    project outside — the logits would be vocab/d_model times wider).
+    ``kv_sink`` (a list) collects each layer's (k, v) projections —
+    the bulk-prefill path fills the decode cache from them; remat is
+    bypassed there (inference has no backward to rematerialize for)."""
     dtype = config.dtype
     seq = tokens.shape[1]
     x = params["embed"][tokens].astype(dtype)
@@ -180,18 +183,30 @@ def _forward(params, tokens, config, attention_fn, pos_offset,
         x = x + pos.astype(dtype)
 
     layer_fn = _layer_forward
-    if config.remat:
+    if config.remat and kv_sink is None:
         # rematerialize each layer's activations in the backward pass —
         # the standard HBM-for-FLOPs trade for long sequences / deep stacks
         layer_fn = jax.checkpoint(
-            _layer_forward, static_argnums=(2, 3, 5, 6, 7, 8)
+            _layer_forward, static_argnums=(2, 3, 5, 6, 7, 8, 9, 10)
         )
+    # prefill (kv_sink set) pins the expert buffers to the token count:
+    # no choice ever drops, so routing is position- and batch-independent,
+    # exactly matching the incremental decode path's capacity contract
+    moe_capacity = (
+        tokens.shape[0] * tokens.shape[1] if kv_sink is not None else None
+    )
     aux_total = jnp.float32(0.0)
     for layer in params["layers"]:
-        x, aux = layer_fn(layer, x, attention_fn, dtype,
-                          positions if use_rope else None,
-                          config.moe_capacity_factor, config.moe_top_k,
-                          config.moe_routing, config.moe_dispatch)
+        out = layer_fn(layer, x, attention_fn, dtype,
+                       positions if use_rope else None,
+                       config.moe_capacity_factor, config.moe_top_k,
+                       config.moe_routing, config.moe_dispatch,
+                       kv_sink is not None, moe_capacity)
+        if kv_sink is None:
+            x, aux = out
+        else:
+            x, aux, kv = out
+            kv_sink.append(kv)
         aux_total = aux_total + aux
 
     x = _rms_norm(x, params["final_norm"]["scale"])
@@ -203,9 +218,15 @@ def _forward(params, tokens, config, attention_fn, pos_offset,
 def _layer_forward(layer, x, attention_fn, dtype, rope_positions_or_none,
                    moe_capacity_factor: float = 1.25, moe_top_k: int = 1,
                    moe_routing: str = "tokens_choose",
-                   moe_dispatch: str = "scatter"):
+                   moe_dispatch: str = "scatter", kv_out: bool = False,
+                   moe_capacity=None):
     """One transformer layer; returns (x, aux) where aux is the MoE
-    load-balancing loss (0.0 for dense-MLP layers)."""
+    load-balancing loss (0.0 for dense-MLP layers).  ``kv_out=True``
+    additionally returns the (roped) k/v projections — the bulk-prefill
+    path writes them straight into the decode cache.  ``moe_capacity``
+    overrides the factor-derived expert buffer (prefill pins it to the
+    token count so no choice ever drops — decode's batch-independence
+    contract)."""
     # attention block
     y = _rms_norm(x, layer["norm1"]["scale"])
     q = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wq"].astype(dtype))
@@ -228,10 +249,16 @@ def _layer_forward(layer, x, attention_fn, dtype, rope_positions_or_none,
                       capacity_factor=moe_capacity_factor,
                       top_k=moe_top_k, routing=moe_routing,
                       dispatch=moe_dispatch),
+            capacity=moe_capacity,
         )
-        return x + out.astype(dtype), aux
-    y = jax.nn.gelu(y @ layer["mlp"]["w_in"].astype(dtype))
-    return x + y @ layer["mlp"]["w_out"].astype(dtype), jnp.float32(0.0)
+        x = x + out.astype(dtype)
+    else:
+        y = jax.nn.gelu(y @ layer["mlp"]["w_in"].astype(dtype))
+        x = x + y @ layer["mlp"]["w_out"].astype(dtype)
+        aux = jnp.float32(0.0)
+    if kv_out:
+        return x, aux, (k, v)
+    return x, aux
 
 
 def transformer_apply(

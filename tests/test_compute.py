@@ -910,7 +910,10 @@ class TestDecoding:
         return config, params
 
     def test_incremental_matches_full_forward(self):
-        from kubeshare_tpu.models.decoding import prefill
+        # the incremental path explicitly: bulk prefill IS the dense
+        # forward, so comparing it to dense would be a tautology
+        from kubeshare_tpu.models.decoding import (
+            prefill_incremental as prefill)
 
         config, params = self._setup()
         prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0, 64)
@@ -926,7 +929,8 @@ class TestDecoding:
         """GQA decode: the grouped cached-attention path (KV cache holds
         n_kv_heads, query heads grouped over it with no materialized
         repetition) must equal the dense GQA forward."""
-        from kubeshare_tpu.models.decoding import init_kv_cache, prefill
+        from kubeshare_tpu.models.decoding import (
+            init_kv_cache, prefill_incremental as prefill)
         from kubeshare_tpu.models.transformer import (
             TransformerConfig, transformer_init)
 
@@ -945,6 +949,55 @@ class TestDecoding:
             np.asarray(dense[:, -1]), np.asarray(last_logits),
             rtol=2e-4, atol=2e-4,
         )
+
+    def test_bulk_prefill_matches_incremental(self):
+        """The bulk prefill (one dense forward + bulk cache fill) must
+        produce the same cache and logits as the token-at-a-time oracle —
+        for MHA, GQA, and a MoE config (whose expert buffers prefill pins
+        to the token count so routing stays position/batch-independent)."""
+        from kubeshare_tpu.models.decoding import (
+            greedy_decode, prefill, prefill_incremental)
+        from kubeshare_tpu.models.transformer import (
+            TransformerConfig, transformer_init)
+
+        cases = {
+            "mha": dict(),
+            "gqa_rope": dict(n_kv_heads=2, positional="rope"),
+            "moe": dict(moe_every=2, moe_num_experts=4, moe_top_k=2),
+            "windowed": dict(attention_window=6),
+        }
+        for name, extra in cases.items():
+            config = TransformerConfig(
+                vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                max_seq_len=32, dtype=jnp.float32, attention="reference",
+                **extra)
+            params = transformer_init(jax.random.PRNGKey(0), config)
+            prompt = jax.random.randint(
+                jax.random.PRNGKey(1), (2, 10), 0, 64)
+            cache_b, logits_b = prefill(params, config, prompt)
+            cache_i, logits_i = prefill_incremental(params, config, prompt)
+            np.testing.assert_allclose(
+                np.asarray(logits_b), np.asarray(logits_i),
+                rtol=2e-4, atol=2e-4, err_msg=name)
+            assert int(cache_b["length"]) == int(cache_i["length"]) == 10
+            np.testing.assert_allclose(
+                np.asarray(cache_b["k"]), np.asarray(cache_i["k"]),
+                rtol=2e-4, atol=2e-4, err_msg=name)
+            np.testing.assert_allclose(
+                np.asarray(cache_b["v"]), np.asarray(cache_i["v"]),
+                rtol=2e-4, atol=2e-4, err_msg=name)
+            # and the next decode step computes identical logits from
+            # either cache
+            from kubeshare_tpu.models.decoding import _decode_one
+
+            token = jnp.argmax(logits_b, axis=-1).astype(jnp.int32)
+            step_b, _ = _decode_one(params, config, cache_b, token)
+            step_i, _ = _decode_one(params, config, cache_i, token)
+            np.testing.assert_allclose(
+                np.asarray(step_b), np.asarray(step_i),
+                rtol=2e-4, atol=2e-4, err_msg=name)
+            out = greedy_decode(params, config, prompt, 4)
+            assert out.shape == (2, 4)
 
     def test_gqa_head_count_validated(self):
         from kubeshare_tpu.models.transformer import (
@@ -976,7 +1029,8 @@ class TestDecoding:
         keeps (ADVICE r1: cached path used to attend over full history)."""
         from dataclasses import replace
 
-        from kubeshare_tpu.models.decoding import prefill
+        from kubeshare_tpu.models.decoding import (
+            prefill_incremental as prefill)
 
         config, params = self._setup()
         config = replace(config, attention_window=4)
@@ -1393,7 +1447,8 @@ class TestRope:
         np.testing.assert_allclose(scores(0), scores(17), rtol=1e-4, atol=1e-5)
 
     def test_rope_transformer_and_decode_consistent(self):
-        from kubeshare_tpu.models.decoding import prefill
+        from kubeshare_tpu.models.decoding import (
+            prefill_incremental as prefill)
 
         config = TransformerConfig(
             vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
@@ -1506,7 +1561,8 @@ class TestMoEFlagship:
         assert np.abs(g_router).sum() > 0
 
     def test_decode_matches_full_forward(self):
-        from kubeshare_tpu.models.decoding import prefill
+        from kubeshare_tpu.models.decoding import (
+            prefill_incremental as prefill)
 
         config = self._config()
         params = transformer_init(jax.random.PRNGKey(0), config)
