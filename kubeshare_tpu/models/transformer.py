@@ -306,12 +306,9 @@ def _validate_sp_entry(
             f"sequence-parallel attention needs a {seq_axis!r} mesh axis "
             f"(got {tuple(mesh.shape)})"
         )
-    if strategy == "ring" and config.attention_window is not None:
-        raise ValueError(
-            "attention_window is not supported on the ring path (K/V "
-            "visibility there is ring-position-dependent); use "
-            "attention='ulysses', which composes with windows"
-        )
+    # a window on the CONTIGUOUS einsum ring is supported (out-of-band
+    # ring steps skip their block math); the zigzag/flash ring callers
+    # get a loud error at the op layer
     if strategy == "ulysses" and (
         config.n_heads % mesh.shape[seq_axis] != 0
         or config.kv_heads % mesh.shape[seq_axis] != 0
@@ -364,6 +361,20 @@ def transformer_apply_ring(
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown ring layout {layout!r}")
     zigzag = layout == "zigzag"
+    window = config.attention_window
+    if window is not None:
+        if zigzag:
+            raise ValueError(
+                "attention_window is not supported on the zigzag ring "
+                "(its load-balance math assumes the full causal band); "
+                "use layout='contiguous' or attention='ulysses'"
+            )
+        if use_flash:
+            raise ValueError(
+                "windowed ring attention runs the einsum ring; pass "
+                "use_flash=False (or leave it unset)"
+            )
+        use_flash = False
     sp = mesh.shape[seq_axis]
     if use_flash is None:
         from ..ops.ring_attention import ring_flash_auto
@@ -394,7 +405,7 @@ def transformer_apply_ring(
                 )
             else:
                 attention_fn = lambda q, k, v: ring_attention(
-                    q, k, v, axis_name=seq_axis, causal=True
+                    q, k, v, axis_name=seq_axis, causal=True, window=window
                 )
         # zigzag: return hidden states and project outside — the inverse
         # permutation then moves d_model-wide rows, not vocab-wide logits
@@ -434,9 +445,10 @@ def transformer_apply_ulysses(
     the shards to head-parallel for a FULL-sequence local attention (the
     flash kernel at its best shapes), then swap back (ops/ulysses.py).
 
-    Unlike the ring path this supports ``attention_window`` — the local
-    attention sees the whole sequence — but needs
-    ``n_heads % mesh.shape[seq_axis] == 0``."""
+    Supports ``attention_window`` (the all-to-all hands each device whole
+    heads over the whole sequence, so the flash kernel's banding applies
+    directly; the ring composes with windows too, via its einsum body);
+    needs ``n_heads % mesh.shape[seq_axis] == 0``."""
     from ..ops.ulysses import ulysses_attention
 
     _validate_sp_entry("ulysses", config, mesh, seq_axis)
@@ -605,9 +617,16 @@ def _pipeline_stage_setup(params, seq_len, config, mesh, pp_axis, seq_axis,
         from ..ops.ulysses import ulysses_attention
 
         ring_use_flash = use_flash
-        if config.attention == "ring" and ring_use_flash is None:
-            ring_use_flash = ring_flash_auto(seq_len, mesh, seq_axis,
-                                             interpret)
+        if config.attention == "ring":
+            if config.attention_window is not None:
+                if ring_use_flash:
+                    raise ValueError(
+                        "windowed ring attention runs the einsum ring; "
+                        "pass use_flash=False (or leave it unset)")
+                ring_use_flash = False
+            elif ring_use_flash is None:
+                ring_use_flash = ring_flash_auto(seq_len, mesh, seq_axis,
+                                                 interpret)
 
         def stage_fn(stage_layers, x):
             # inside shard_map over (pp, sp): x is the local sequence shard
@@ -616,7 +635,8 @@ def _pipeline_stage_setup(params, seq_len, config, mesh, pp_axis, seq_axis,
             pos = rope_positions(local_seq, offset) if use_rope else None
             if config.attention == "ring":
                 fn = ring_flash_attention if ring_use_flash else ring_attention
-                kwargs = {"interpret": interpret} if ring_use_flash else {}
+                kwargs = ({"interpret": interpret} if ring_use_flash
+                          else {"window": config.attention_window})
                 attn = lambda q, k, v: fn(
                     q, k, v, axis_name=seq_axis, causal=True, **kwargs)
             else:

@@ -47,13 +47,20 @@ def _block_pv(probs, v):
     return jnp.einsum("bngqk,bnkd->bngqd", p5, v).reshape(b, h, sq, d)
 
 
-def _ring_online_softmax(q, k, v, axis_name, causal, q_pos, k_pos_for_src):
+def _ring_online_softmax(q, k, v, axis_name, causal, q_pos, k_pos_for_src,
+                         window=None):
     """Shared online-softmax ring body: K/V rotate via ppermute while a
     numerically-stable streaming softmax accumulates.  The sequence layout
     is abstracted behind ``q_pos`` (this device's global query positions)
     and ``k_pos_for_src(src)`` (global key positions of the shard that
     started on ring position ``src``) — the contiguous and zigzag rings
-    differ only there."""
+    differ only there.
+
+    ``window`` (causal only): sliding-window band ``q_pos - k_pos <
+    window``.  Blocks entirely outside the visible band — fully future,
+    or fully past the window — skip their math under lax.cond, so the
+    per-device cost approaches O(s_local * window) as the band narrows
+    (the K/V rotation still travels the whole ring)."""
     axis_size = jax.lax.psum(1, axis_name)
     my_index = jax.lax.axis_index(axis_name)
     scale = q.shape[-1] ** -0.5
@@ -63,25 +70,42 @@ def _ring_online_softmax(q, k, v, axis_name, causal, q_pos, k_pos_for_src):
 
     def accumulate(t, k_cur, v_cur, m, l, acc):
         src = (my_index - t) % axis_size  # ring position this K/V came from
-        scores = _block_scores(q, k_cur, scale)  # [b,h,sq,sk] f32
-        if causal:
-            k_pos = k_pos_for_src(src)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            scores = jnp.where(mask[None, None], scores, -jnp.inf)
-        block_max = jnp.max(scores, axis=-1)  # [b,h,sq]
-        new_m = jnp.maximum(m, block_max)
-        # guard fully-masked rows (new_m = -inf): contribute nothing
-        safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
-        probs = jnp.exp(scores - safe_m[..., None])
-        probs = jnp.where(jnp.isfinite(scores), probs, 0.0)
-        correction = jnp.where(
-            jnp.isfinite(m), jnp.exp(m - safe_m), 0.0
-        )  # rescale old accumulators
-        l = l * correction + jnp.sum(probs, axis=-1)
-        acc = acc * correction[..., None] + _block_pv(
-            probs.astype(v_cur.dtype), v_cur
-        ).astype(jnp.float32)
-        return new_m, l, acc
+        k_pos = k_pos_for_src(src) if causal else None
+
+        def block(args):
+            k_cur, v_cur, m, l, acc = args
+            scores = _block_scores(q, k_cur, scale)  # [b,h,sq,sk] f32
+            if causal:
+                mask = q_pos[:, None] >= k_pos[None, :]
+                if window is not None:
+                    mask &= (q_pos[:, None] - k_pos[None, :]) < window
+                scores = jnp.where(mask[None, None], scores, -jnp.inf)
+            block_max = jnp.max(scores, axis=-1)  # [b,h,sq]
+            new_m = jnp.maximum(m, block_max)
+            # guard fully-masked rows (new_m = -inf): contribute nothing
+            safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+            probs = jnp.exp(scores - safe_m[..., None])
+            probs = jnp.where(jnp.isfinite(scores), probs, 0.0)
+            correction = jnp.where(
+                jnp.isfinite(m), jnp.exp(m - safe_m), 0.0
+            )  # rescale old accumulators
+            new_l = l * correction + jnp.sum(probs, axis=-1)
+            new_acc = acc * correction[..., None] + _block_pv(
+                probs.astype(v_cur.dtype), v_cur
+            ).astype(jnp.float32)
+            return new_m, new_l, new_acc
+
+        args = (k_cur, v_cur, m, l, acc)
+        if not causal:
+            return block(args)
+        # fully-out-of-band blocks contribute exactly nothing: skip the
+        # block math (the backward's masked_for_src does the same)
+        skip = jnp.min(k_pos) > jnp.max(q_pos)  # entirely future
+        if window is not None:
+            # entirely past the window's left edge
+            skip |= (jnp.min(q_pos) - jnp.max(k_pos)) >= window
+        return jax.lax.cond(
+            skip, lambda a: (a[2], a[3], a[4]), block, args)
 
     def step(t, carry):
         # kick the next rotation off BEFORE computing on the current block:
@@ -122,15 +146,24 @@ def ring_attention(
     v: jax.Array,
     axis_name: str = "sp",
     causal: bool = True,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Attention across the ring; call inside shard_map with the sequence
-    axis sharded over ``axis_name``."""
+    axis sharded over ``axis_name``.
+
+    ``window`` (implies causal): sliding-window band over global
+    positions; fully-out-of-band ring steps skip their block math."""
+    if window is not None and window <= 0:
+        raise ValueError(f"window must be positive, got {window}")
+    if window is not None and not causal:
+        raise ValueError("window implies causal attention")
     my_index = jax.lax.axis_index(axis_name)
     s_local = q.shape[2]
     return _ring_online_softmax(
         q, k, v, axis_name, causal,
         _contiguous_positions(my_index, s_local),
         lambda src: _contiguous_positions(src, s_local),
+        window=window,
     )
 
 
@@ -710,9 +743,16 @@ def ring_attention_sharded(
     use_flash: Optional[bool] = None,
     interpret: bool = False,
     layout: str = "contiguous",
+    window: Optional[int] = None,
 ) -> jax.Array:
     """shard_map wrapper: [batch, heads, seq, head_dim] with batch over dp,
     heads over tp, and sequence over sp.
+
+    ``window``: sliding-window (causal) attention on the contiguous
+    einsum ring — out-of-band ring steps skip their block math, so cost
+    approaches O(s x window).  Not composable with the flash hybrid or
+    the zigzag layout (whose balance math is band-dependent); those
+    callers get a loud error rather than silently full attention.
 
     ``use_flash=None`` auto-selects the hybrid ring (causal flash kernel on
     the diagonal step, einsum partials on fully-visible steps) on TPU when
@@ -731,6 +771,21 @@ def ring_attention_sharded(
         raise ValueError(f"unknown ring layout {layout!r}")
     if layout == "zigzag" and not causal:
         raise ValueError("zigzag layout only balances causal attention")
+    if window is not None:
+        if not causal:
+            raise ValueError("window implies causal attention")
+        if layout == "zigzag":
+            raise ValueError(
+                "window is not supported on the zigzag layout (its "
+                "load-balance math assumes the full causal band); use "
+                "layout='contiguous'"
+            )
+        if use_flash:
+            raise ValueError(
+                "windowed ring attention runs the einsum ring; pass "
+                "use_flash=False (or leave it unset)"
+            )
+        use_flash = False
     if layout == "zigzag" and isinstance(q, jax.core.Tracer):
         # each wrapper call pays two global permutations (shard + unshard);
         # a multi-layer model calling it per layer turns that into a
@@ -766,7 +821,8 @@ def ring_attention_sharded(
             interpret=interpret,
         )
     else:
-        fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
+        fn = functools.partial(ring_attention, axis_name=seq_axis,
+                               causal=causal, window=window)
     # interpret-mode pallas evaluation mixes varying and invariant operands
     # in its block slicing, which the vma checker rejects; the compiled TPU
     # kernel (and the einsum path) keep full checking
