@@ -11,7 +11,13 @@ from .transformer import (
     transformer_sharding_rules,
     transformer_fsdp_rules,
 )
-from .decoding import greedy_decode, init_kv_cache, prefill, sample_decode
+from .decoding import (
+    greedy_decode,
+    init_kv_cache,
+    prefill,
+    prefill_chunked,
+    sample_decode,
+)
 
 __all__ = [
     "transformer_apply_ring",
@@ -22,6 +28,7 @@ __all__ = [
     "greedy_decode",
     "init_kv_cache",
     "prefill",
+    "prefill_chunked",
     "sample_decode",
     "MnistConfig",
     "mnist_init",

@@ -52,44 +52,53 @@ def init_kv_cache(config: TransformerConfig, batch: int) -> Dict:
     }
 
 
-def _attend_cached(q, cache_k, cache_v, length, window=None):
-    """q: [b,h,1,d] against cache [b,h_kv,S,d]; positions >= length masked.
+def _attend_cached(q, cache_k, cache_v, q_positions, window=None):
+    """q: [b,h,Cq,d] against cache [b,h_kv,S,d]; per-query causal band.
+
+    ``q_positions`` [Cq] are the queries' global positions: query i sees
+    cache slots ``k_pos <= q_positions[i]`` (and, with a window, within
+    ``q_pos - k_pos < window`` — the same band transformer_apply's dense
+    mask keeps).  Cq = 1 is the decode step; Cq > 1 is a prefill chunk.
 
     GQA: when h > h_kv the query heads are grouped over the shared KV
-    heads ([b, h_kv, g, 1, d] x [b, h_kv, S, d]) — no KV repetition is
+    heads ([b, h_kv, g, Cq, d] x [b, h_kv, S, d]) — no KV repetition is
     materialized, so the einsum reads each cached key/value once.
-
-    With sliding-window attention the query sits at position ``length - 1``
-    and may only see keys where ``q_pos - k_pos < window``, i.e. positions
-    ``>= length - window`` — the same band transformer_apply's dense mask
-    keeps (ops/attention.py window semantics).
     """
-    b, h, _, d = q.shape
+    b, h, cq, d = q.shape
     h_kv = cache_k.shape[1]
     group = h // h_kv
     scale = d ** -0.5
-    qg = q.reshape(b, h_kv, group, q.shape[2], d)
+    qg = q.reshape(b, h_kv, group, cq, d)
     scores = jnp.einsum(
         "bhgqd,bhkd->bhgqk", qg, cache_k).astype(jnp.float32) * scale
-    positions = jnp.arange(cache_k.shape[2])
-    valid = positions[None, None, None, None, :] < length
+    k_pos = jnp.arange(cache_k.shape[2])
+    valid = k_pos[None, :] <= q_positions[:, None]  # [Cq, S]
     if window is not None:
-        valid = valid & (
-            positions[None, None, None, None, :] >= length - window)
-    scores = jnp.where(valid, scores, -jnp.inf)
+        valid = valid & (q_positions[:, None] - k_pos[None, :] < window)
+    scores = jnp.where(valid[None, None, None], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1).astype(cache_v.dtype)
     out = jnp.einsum("bhgqk,bhkd->bhgqd", probs, cache_v)
-    return out.reshape(b, h, q.shape[2], d)
+    return out.reshape(b, h, cq, d)
 
 
-def _decode_one(params, config: TransformerConfig, cache: Dict, token: jax.Array):
-    """One decode step: token [batch] -> (logits [batch, vocab], cache)."""
+def _decode_chunk(params, config: TransformerConfig, cache: Dict,
+                  tokens: jax.Array):
+    """A width-C cached step: tokens [batch, C] at positions
+    ``length .. length+C-1`` -> (logits [batch, C, vocab], cache).
+
+    C = 1 is the decode step; C > 1 is a prefill chunk — the chunk's
+    K/V land in the cache first, then its queries attend the whole
+    cache under the per-query causal band, so intra-chunk causality
+    falls out of the same mask that orders chunk vs history."""
     dtype = config.dtype
     position = cache["length"]
-    x = params["embed"][token].astype(dtype)[:, None, :]  # [b,1,d]
+    chunk = tokens.shape[1]
+    positions = position + jnp.arange(chunk)  # global positions [C]
+    x = params["embed"][tokens].astype(dtype)  # [b,C,d]
     use_rope = config.positional == "rope"
     if not use_rope:
-        pos_embed = jax.lax.dynamic_slice_in_dim(params["pos_embed"], position, 1)
+        pos_embed = jax.lax.dynamic_slice_in_dim(
+            params["pos_embed"], position, chunk)
         x = x + pos_embed.astype(dtype)
 
     new_k, new_v = [], []
@@ -99,8 +108,8 @@ def _decode_one(params, config: TransformerConfig, cache: Dict, token: jax.Array
         k = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wk"].astype(dtype))
         v = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wv"].astype(dtype))
         if use_rope:
-            q = apply_rope(q, position[None])  # length is always a scalar
-            k = apply_rope(k, position[None])
+            q = apply_rope(q, positions)
+            k = apply_rope(k, positions)
         cache_k = jax.lax.dynamic_update_slice_in_dim(
             cache["k"][layer_idx], k, position, axis=2
         )
@@ -110,17 +119,16 @@ def _decode_one(params, config: TransformerConfig, cache: Dict, token: jax.Array
         new_k.append(cache_k)
         new_v.append(cache_v)
         o = _attend_cached(
-            q, cache_k, cache_v, position + 1, window=config.attention_window
+            q, cache_k, cache_v, positions, window=config.attention_window
         ).astype(dtype)
         x = x + jnp.einsum("bhsk,hkd->bsd", o, layer["attn"]["wo"].astype(dtype))
         y = _rms_norm(x, layer["norm2"]["scale"])
         if "moe" in layer:
-            # single-token MoE step: routing is per-token (top-k).  The
-            # step only sees batch-many tokens, so a factor-derived
-            # capacity would collapse to ~1 and silently drop rows that
-            # share an expert; capacity=batch guarantees no drops (each
-            # token routes to an expert at most once) and the buffer
-            # stays tiny.
+            # per-chunk MoE: routing is per-token (top-k).  A factor-
+            # derived capacity over batch*chunk tokens could drop rows
+            # that share an expert; capacity = the chunk's token count
+            # guarantees no drops (a token routes to an expert at most
+            # once), keeping routing position- and batch-independent.
             from ..ops.moe import MoEConfig, moe_apply
 
             _check_moe_decodable(config)
@@ -139,13 +147,19 @@ def _decode_one(params, config: TransformerConfig, cache: Dict, token: jax.Array
             x = x + y @ layer["mlp"]["w_out"].astype(dtype)
 
     x = _rms_norm(x, params["final_norm"]["scale"])
-    logits = (x[:, 0] @ params["lm_head"].astype(dtype)).astype(jnp.float32)
+    logits = (x @ params["lm_head"].astype(dtype)).astype(jnp.float32)
     cache = {
         "k": jnp.stack(new_k),
         "v": jnp.stack(new_v),
-        "length": position + 1,
+        "length": position + chunk,
     }
     return logits, cache
+
+
+def _decode_one(params, config: TransformerConfig, cache: Dict, token: jax.Array):
+    """One decode step: token [batch] -> (logits [batch, vocab], cache)."""
+    logits, cache = _decode_chunk(params, config, cache, token[:, None])
+    return logits[:, 0], cache
 
 
 def prefill(params, config: TransformerConfig, prompt: jax.Array) -> Tuple[Dict, jax.Array]:
@@ -185,22 +199,45 @@ def prefill(params, config: TransformerConfig, prompt: jax.Array) -> Tuple[Dict,
     return cache, last_logits
 
 
+def prefill_chunked(
+    params, config: TransformerConfig, prompt: jax.Array, chunk: int,
+) -> Tuple[Dict, jax.Array]:
+    """Prefill in fixed-size chunks: each chunk is one cached step
+    (:func:`_decode_chunk`), so peak activation memory is O(chunk)
+    instead of the bulk path's O(prompt_len) — the long-prompt regime —
+    while every chunk still runs MXU-shaped [b, chunk, d] matmuls
+    rather than the incremental path's [b, 1, d] slivers.  The prompt
+    length must tile ``chunk`` (pad the prompt, or pick a divisor)."""
+    batch, prompt_len = prompt.shape
+    _check_prompt_fits(config, prompt_len)
+    _check_moe_decodable(config)
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    if prompt_len % chunk != 0:
+        raise ValueError(
+            f"prompt length {prompt_len} does not tile chunk {chunk}; pad "
+            "the prompt or pick a divisor"
+        )
+    cache = init_kv_cache(config, batch)
+
+    def step(cache, chunk_tokens):
+        logits, cache = _decode_chunk(params, config, cache,
+                                      chunk_tokens.T)
+        return cache, logits[:, -1]
+
+    chunks = prompt.T.reshape(prompt_len // chunk, chunk, batch)
+    cache, last_logits = jax.lax.scan(step, cache, chunks)
+    return cache, last_logits[-1]
+
+
 def prefill_incremental(
     params, config: TransformerConfig, prompt: jax.Array
 ) -> Tuple[Dict, jax.Array]:
-    """Token-at-a-time prefill via the decode step (the original path):
-    the equivalence oracle for the bulk prefill, and the fallback for
-    configs whose dense forward cannot run here."""
-    batch, prompt_len = prompt.shape
-    _check_prompt_fits(config, prompt_len)
-    cache = init_kv_cache(config, batch)
-
-    def step(cache, token):
-        logits, cache = _decode_one(params, config, cache, token)
-        return cache, logits
-
-    cache, all_logits = jax.lax.scan(step, cache, prompt.T)
-    return cache, all_logits[-1]
+    """Token-at-a-time prefill via the decode step: the equivalence
+    oracle for the bulk prefill, and the fallback for configs whose
+    dense forward cannot run here.  Exactly the chunked path at width 1
+    — one scan body to maintain."""
+    return prefill_chunked(params, config, prompt, 1)
 
 
 def greedy_decode(

@@ -363,18 +363,10 @@ def transformer_apply_ring(
     zigzag = layout == "zigzag"
     window = config.attention_window
     if window is not None:
-        if zigzag:
-            raise ValueError(
-                "attention_window is not supported on the zigzag ring "
-                "(its load-balance math assumes the full causal band); "
-                "use layout='contiguous' or attention='ulysses'"
-            )
-        if use_flash:
-            raise ValueError(
-                "windowed ring attention runs the einsum ring; pass "
-                "use_flash=False (or leave it unset)"
-            )
-        use_flash = False
+        from ..ops.ring_attention import resolve_windowed_ring
+
+        use_flash = resolve_windowed_ring(window, zigzag=zigzag,
+                                          use_flash=use_flash)
     sp = mesh.shape[seq_axis]
     if use_flash is None:
         from ..ops.ring_attention import ring_flash_auto
@@ -619,11 +611,10 @@ def _pipeline_stage_setup(params, seq_len, config, mesh, pp_axis, seq_axis,
         ring_use_flash = use_flash
         if config.attention == "ring":
             if config.attention_window is not None:
-                if ring_use_flash:
-                    raise ValueError(
-                        "windowed ring attention runs the einsum ring; "
-                        "pass use_flash=False (or leave it unset)")
-                ring_use_flash = False
+                from ..ops.ring_attention import resolve_windowed_ring
+
+                ring_use_flash = resolve_windowed_ring(
+                    config.attention_window, use_flash=ring_use_flash)
             elif ring_use_flash is None:
                 ring_use_flash = ring_flash_auto(seq_len, mesh, seq_axis,
                                                  interpret)

@@ -140,6 +140,36 @@ def _contiguous_positions(index, s_local):
     return index * s_local + jnp.arange(s_local)
 
 
+def resolve_windowed_ring(
+    window: Optional[int],
+    causal: bool = True,
+    zigzag: bool = False,
+    use_flash: Optional[bool] = None,
+) -> Optional[bool]:
+    """Single source for which ring variants compose with a sliding
+    window: only the contiguous einsum ring does.  Returns the resolved
+    ``use_flash`` (forced False when a window is set); raises for the
+    unsupported combinations so no caller silently runs full attention."""
+    if window is None:
+        return use_flash
+    if window <= 0:
+        raise ValueError(f"window must be positive, got {window}")
+    if not causal:
+        raise ValueError("window implies causal attention")
+    if zigzag:
+        raise ValueError(
+            "window is not supported on the zigzag layout (its "
+            "load-balance math assumes the full causal band); use "
+            "layout='contiguous' or attention='ulysses'"
+        )
+    if use_flash:
+        raise ValueError(
+            "windowed ring attention runs the einsum ring; pass "
+            "use_flash=False (or leave it unset)"
+        )
+    return False
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -153,10 +183,7 @@ def ring_attention(
 
     ``window`` (implies causal): sliding-window band over global
     positions; fully-out-of-band ring steps skip their block math."""
-    if window is not None and window <= 0:
-        raise ValueError(f"window must be positive, got {window}")
-    if window is not None and not causal:
-        raise ValueError("window implies causal attention")
+    resolve_windowed_ring(window, causal=causal)
     my_index = jax.lax.axis_index(axis_name)
     s_local = q.shape[2]
     return _ring_online_softmax(
@@ -772,20 +799,9 @@ def ring_attention_sharded(
     if layout == "zigzag" and not causal:
         raise ValueError("zigzag layout only balances causal attention")
     if window is not None:
-        if not causal:
-            raise ValueError("window implies causal attention")
-        if layout == "zigzag":
-            raise ValueError(
-                "window is not supported on the zigzag layout (its "
-                "load-balance math assumes the full causal band); use "
-                "layout='contiguous'"
-            )
-        if use_flash:
-            raise ValueError(
-                "windowed ring attention runs the einsum ring; pass "
-                "use_flash=False (or leave it unset)"
-            )
-        use_flash = False
+        use_flash = resolve_windowed_ring(
+            window, causal=causal, zigzag=layout == "zigzag",
+            use_flash=use_flash)
     if layout == "zigzag" and isinstance(q, jax.core.Tracer):
         # each wrapper call pays two global permutations (shard + unshard);
         # a multi-layer model calling it per layer turns that into a

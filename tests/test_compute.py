@@ -1068,6 +1068,56 @@ class TestDecoding:
             out = greedy_decode(params, config, prompt, 4)
             assert out.shape == (2, 4)
 
+    def test_chunked_prefill_matches_bulk(self):
+        """Chunked prefill (O(chunk) activations per step) must produce
+        the same cache and logits as the bulk dense pass — across
+        MHA/GQA/MoE/windowed configs and chunk sizes incl. chunk=1 (which
+        is exactly the incremental path) and chunk=prompt_len."""
+        from kubeshare_tpu.models.decoding import prefill, prefill_chunked
+        from kubeshare_tpu.models.transformer import (
+            TransformerConfig, transformer_init)
+
+        cases = {
+            "mha": dict(),
+            "gqa_rope": dict(n_kv_heads=2, positional="rope"),
+            "moe": dict(moe_every=2, moe_num_experts=4, moe_top_k=2),
+            "windowed": dict(attention_window=6),
+        }
+        for name, extra in cases.items():
+            config = TransformerConfig(
+                vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                max_seq_len=32, dtype=jnp.float32, attention="reference",
+                **extra)
+            params = transformer_init(jax.random.PRNGKey(0), config)
+            prompt = jax.random.randint(
+                jax.random.PRNGKey(1), (2, 12), 0, 64)
+            cache_b, logits_b = prefill(params, config, prompt)
+            for chunk in (1, 4, 12):
+                cache_c, logits_c = prefill_chunked(
+                    params, config, prompt, chunk)
+                np.testing.assert_allclose(
+                    np.asarray(logits_c), np.asarray(logits_b),
+                    rtol=2e-4, atol=2e-4, err_msg=f"{name} chunk={chunk}")
+                np.testing.assert_allclose(
+                    np.asarray(cache_c["k"]), np.asarray(cache_b["k"]),
+                    rtol=2e-4, atol=2e-4, err_msg=f"{name} chunk={chunk}")
+                assert int(cache_c["length"]) == 12
+
+    def test_chunked_prefill_validates_tiling(self):
+        from kubeshare_tpu.models.decoding import prefill_chunked
+        from kubeshare_tpu.models.transformer import (
+            TransformerConfig, transformer_init)
+
+        config = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=1, d_ff=64,
+            max_seq_len=32, dtype=jnp.float32, attention="reference")
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        prompt = jnp.zeros((1, 10), jnp.int32)
+        with pytest.raises(ValueError, match="tile"):
+            prefill_chunked(params, config, prompt, 4)
+        with pytest.raises(ValueError, match="chunk"):
+            prefill_chunked(params, config, prompt, 0)
+
     def test_gqa_head_count_validated(self):
         from kubeshare_tpu.models.transformer import (
             TransformerConfig, transformer_init)
