@@ -13,6 +13,7 @@ from .transformer import (
 )
 from .decoding import (
     greedy_decode,
+    greedy_decode_with_cache,
     init_kv_cache,
     prefill,
     prefill_chunked,
@@ -26,6 +27,7 @@ __all__ = [
     "transformer_sharding_rules",
     "transformer_fsdp_rules",
     "greedy_decode",
+    "greedy_decode_with_cache",
     "init_kv_cache",
     "prefill",
     "prefill_chunked",

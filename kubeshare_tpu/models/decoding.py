@@ -82,14 +82,19 @@ def _attend_cached(q, cache_k, cache_v, q_positions, window=None):
 
 
 def _decode_chunk(params, config: TransformerConfig, cache: Dict,
-                  tokens: jax.Array):
+                  tokens: jax.Array, head_last_only: bool = False):
     """A width-C cached step: tokens [batch, C] at positions
     ``length .. length+C-1`` -> (logits [batch, C, vocab], cache).
 
     C = 1 is the decode step; C > 1 is a prefill chunk — the chunk's
     K/V land in the cache first, then its queries attend the whole
     cache under the per-query causal band, so intra-chunk causality
-    falls out of the same mask that orders chunk vs history."""
+    falls out of the same mask that orders chunk vs history.
+
+    ``head_last_only``: project lm_head over the final position only
+    (logits [batch, 1, vocab]) — prefill needs just the last row, and a
+    full [batch, C, vocab] f32 buffer would otherwise dominate the
+    chunked step's activations at real vocab sizes."""
     dtype = config.dtype
     position = cache["length"]
     chunk = tokens.shape[1]
@@ -147,7 +152,8 @@ def _decode_chunk(params, config: TransformerConfig, cache: Dict,
             x = x + y @ layer["mlp"]["w_out"].astype(dtype)
 
     x = _rms_norm(x, params["final_norm"]["scale"])
-    logits = (x @ params["lm_head"].astype(dtype)).astype(jnp.float32)
+    head_in = x[:, -1:] if head_last_only else x
+    logits = (head_in @ params["lm_head"].astype(dtype)).astype(jnp.float32)
     cache = {
         "k": jnp.stack(new_k),
         "v": jnp.stack(new_v),
@@ -222,8 +228,8 @@ def prefill_chunked(
 
     def step(cache, chunk_tokens):
         logits, cache = _decode_chunk(params, config, cache,
-                                      chunk_tokens.T)
-        return cache, logits[:, -1]
+                                      chunk_tokens.T, head_last_only=True)
+        return cache, logits[:, 0]
 
     chunks = prompt.T.reshape(prompt_len // chunk, chunk, batch)
     cache, last_logits = jax.lax.scan(step, cache, chunks)
@@ -240,21 +246,17 @@ def prefill_incremental(
     return prefill_chunked(params, config, prompt, 1)
 
 
-def greedy_decode(
-    params, config: TransformerConfig, prompt: jax.Array, max_new_tokens: int
+def greedy_decode_with_cache(
+    params,
+    config: TransformerConfig,
+    cache: Dict,
+    last_logits: jax.Array,
+    max_new_tokens: int,
 ) -> jax.Array:
-    """Greedy generation: returns [batch, max_new_tokens] token ids.
-    Jit-compatible (static max_new_tokens)."""
-    total = prompt.shape[1] + max_new_tokens
-    if total > config.max_seq_len:
-        # dynamic_update_slice would silently clamp at the window edge and
-        # overwrite the last cache slot; fail loudly instead
-        raise ValueError(
-            f"prompt ({prompt.shape[1]}) + max_new_tokens ({max_new_tokens}) "
-            f"= {total} exceeds max_seq_len {config.max_seq_len}"
-        )
-    cache, logits = prefill(params, config, prompt)
-    first_token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    """Greedy continuation from a prefilled cache — the serving split:
+    prefill once (bulk or chunked), decode from its (cache, logits).
+    Returns [batch, max_new_tokens] token ids; jit-compatible."""
+    first_token = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
 
     def step(carry, _):
         cache, token = carry
@@ -269,6 +271,24 @@ def greedy_decode(
     )
     tokens = jnp.concatenate([first_token[None], rest], axis=0)
     return tokens.T  # [batch, new_tokens]
+
+
+def greedy_decode(
+    params, config: TransformerConfig, prompt: jax.Array, max_new_tokens: int
+) -> jax.Array:
+    """Greedy generation: returns [batch, max_new_tokens] token ids.
+    Jit-compatible (static max_new_tokens)."""
+    total = prompt.shape[1] + max_new_tokens
+    if total > config.max_seq_len:
+        # dynamic_update_slice would silently clamp at the window edge and
+        # overwrite the last cache slot; fail loudly instead
+        raise ValueError(
+            f"prompt ({prompt.shape[1]}) + max_new_tokens ({max_new_tokens}) "
+            f"= {total} exceeds max_seq_len {config.max_seq_len}"
+        )
+    cache, logits = prefill(params, config, prompt)
+    return greedy_decode_with_cache(params, config, cache, logits,
+                                    max_new_tokens)
 
 
 def _filter_logits(

@@ -1101,7 +1101,30 @@ class TestDecoding:
                 np.testing.assert_allclose(
                     np.asarray(cache_c["k"]), np.asarray(cache_b["k"]),
                     rtol=2e-4, atol=2e-4, err_msg=f"{name} chunk={chunk}")
+                np.testing.assert_allclose(
+                    np.asarray(cache_c["v"]), np.asarray(cache_b["v"]),
+                    rtol=2e-4, atol=2e-4, err_msg=f"{name} chunk={chunk}")
                 assert int(cache_c["length"]) == 12
+
+    def test_decode_from_chunked_cache_matches_greedy(self):
+        """The serving split — chunked prefill + greedy_decode_with_cache
+        — must emit the same tokens as the one-shot greedy_decode."""
+        from kubeshare_tpu.models.decoding import (
+            greedy_decode, greedy_decode_with_cache, prefill_chunked)
+        from kubeshare_tpu.models.transformer import (
+            TransformerConfig, transformer_init)
+
+        config = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_kv_heads=2, n_layers=2,
+            d_ff=64, max_seq_len=32, dtype=jnp.float32,
+            attention="reference", positional="rope")
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, 64)
+        one_shot = greedy_decode(params, config, prompt, 8)
+        cache, logits = prefill_chunked(params, config, prompt, 4)
+        split = greedy_decode_with_cache(params, config, cache, logits, 8)
+        np.testing.assert_array_equal(np.asarray(one_shot),
+                                      np.asarray(split))
 
     def test_chunked_prefill_validates_tiling(self):
         from kubeshare_tpu.models.decoding import prefill_chunked
