@@ -18,6 +18,7 @@ from .decoding import (
     prefill,
     prefill_chunked,
     sample_decode,
+    speculative_greedy_decode,
 )
 
 __all__ = [
@@ -32,6 +33,7 @@ __all__ = [
     "prefill",
     "prefill_chunked",
     "sample_decode",
+    "speculative_greedy_decode",
     "MnistConfig",
     "mnist_init",
     "mnist_apply",

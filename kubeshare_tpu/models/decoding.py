@@ -256,6 +256,23 @@ def greedy_decode_with_cache(
     """Greedy continuation from a prefilled cache — the serving split:
     prefill once (bulk or chunked), decode from its (cache, logits).
     Returns [batch, max_new_tokens] token ids; jit-compatible."""
+    capacity = cache["k"].shape[3]
+    length = cache["length"]
+    if not isinstance(length, jax.core.Tracer):
+        # same loud failure greedy_decode gives: past capacity,
+        # dynamic_update_slice clamps and silently overwrites the last
+        # cache slot
+        if int(length) + max_new_tokens > capacity:
+            raise ValueError(
+                f"cache length {int(length)} + max_new_tokens "
+                f"{max_new_tokens} exceeds the cache capacity {capacity}"
+            )
+    elif max_new_tokens > capacity:
+        # under jit the length is traced; at least the static bound holds
+        raise ValueError(
+            f"max_new_tokens {max_new_tokens} exceeds the cache "
+            f"capacity {capacity}"
+        )
     first_token = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
 
     def step(carry, _):
@@ -289,6 +306,113 @@ def greedy_decode(
     cache, logits = prefill(params, config, prompt)
     return greedy_decode_with_cache(params, config, cache, logits,
                                     max_new_tokens)
+
+
+def speculative_greedy_decode(
+    params,
+    config: TransformerConfig,
+    draft_params,
+    draft_config: TransformerConfig,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    draft_len: int = 4,
+) -> jax.Array:
+    """Greedy generation with draft-model speculation: emits EXACTLY the
+    tokens :func:`greedy_decode` would, in fewer target-model passes.
+
+    Each round the draft proposes ``draft_len - 1`` tokens one at a time
+    (cheap model, tiny steps), then the target verifies the whole
+    proposal in ONE width-``draft_len`` cached chunk (
+    :func:`_decode_chunk` — an MXU-shaped matmul instead of draft_len
+    tiny steps).  The longest matching prefix is accepted plus the
+    target's own next token (the standard greedy acceptance rule, which
+    preserves the target's exact argmax stream); a mismatch costs
+    nothing — the correction token comes from the same verify pass.
+    Batched rows share the cache length, so acceptance is the minimum
+    across rows (batch 1 gets the full per-round speedup).
+
+    The verify chunk writes its K/V optimistically; rejected positions
+    are simply masked out by the rewound cache length and overwritten by
+    the next round.  Both models must share a vocabulary; the caches
+    need headroom of ``draft_len`` beyond the generated text."""
+    batch, prompt_len = prompt.shape
+    if draft_len < 2:
+        raise ValueError(f"draft_len must be >= 2, got {draft_len}")
+    if config.vocab_size != draft_config.vocab_size:
+        raise ValueError(
+            f"target and draft vocabularies differ "
+            f"({config.vocab_size} vs {draft_config.vocab_size})"
+        )
+    total = prompt_len + max_new_tokens + draft_len
+    for name, c in (("target", config), ("draft", draft_config)):
+        if total > c.max_seq_len:
+            raise ValueError(
+                f"prompt + max_new_tokens + draft_len = {total} exceeds "
+                f"the {name} max_seq_len {c.max_seq_len} (speculation "
+                f"needs draft_len slots of cache headroom)"
+            )
+
+    cache, logits = prefill(params, config, prompt)
+    dcache, _ = prefill(draft_params, draft_config, prompt)
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [b]
+    out = jnp.zeros((batch, max_new_tokens + draft_len), jnp.int32)
+    out = out.at[:, 0].set(first)
+
+    def cond(state):
+        _, _, _, n_done, _ = state
+        return n_done < max_new_tokens
+
+    def body(state):
+        cache, dcache, out, n_done, last = state
+
+        # 1. draft proposes draft_len-1 tokens after `last`.  The scan
+        # runs draft_len steps: the final step feeds p_{k-1} (its output
+        # is discarded) so the draft cache holds K/V for every token the
+        # round may accept — a full accept needs p_{k-1}'s entry.
+        def draft_step(carry, _):
+            dc, tok = carry
+            lg, dc = _decode_one(draft_params, draft_config, dc, tok)
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            return (dc, nxt), nxt
+
+        (dcache, _), proposal = jax.lax.scan(
+            draft_step, (dcache, last), None, length=draft_len)
+        proposal = proposal.T[:, :draft_len - 1]  # [b, draft_len-1]
+
+        # 2. target verifies the whole round in one chunk: inputs
+        # [last, p_1..p_{k-1}] -> greedy targets t_1..t_k (t_k = bonus)
+        chunk = jnp.concatenate([last[:, None], proposal], axis=1)
+        target_length = cache["length"]
+        chunk_logits, cache = _decode_chunk(params, config, cache, chunk)
+        targets = jnp.argmax(chunk_logits, axis=-1).astype(jnp.int32)
+
+        # 3. longest matching prefix, shared across rows (one cache length)
+        matches = jnp.cumprod(
+            (proposal == targets[:, :-1]).astype(jnp.int32), axis=1)
+        m = jnp.min(jnp.sum(matches, axis=1))  # 0..draft_len-1
+
+        # 4. the emitted stream: p_1..p_m then the target's correction /
+        # bonus t_{m+1}; positions past m are speculative garbage that
+        # later rounds overwrite (and the final slice drops)
+        idx = jnp.arange(draft_len)
+        stream = jnp.where(
+            idx[None, :] < m,
+            jnp.pad(proposal, ((0, 0), (0, 1))),
+            targets,
+        )
+        out = jax.lax.dynamic_update_slice(out, stream, (0, n_done))
+
+        # 5. keep only the consumed inputs' K/V: [last, p_1..p_m] —
+        # rejected (and draft-overshoot) entries are masked by the
+        # rewound length and overwritten next round
+        cache = dict(cache, length=target_length + m + 1)
+        dcache = dict(dcache, length=target_length + m + 1)
+        last = stream[:, m]
+        return cache, dcache, out, n_done + m + 1, last
+
+    _, _, out, _, _ = jax.lax.while_loop(
+        cond, body, (cache, dcache, out, jnp.int32(1), first))
+    return out[:, :max_new_tokens]
 
 
 def _filter_logits(

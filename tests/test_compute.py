@@ -1125,6 +1125,9 @@ class TestDecoding:
         split = greedy_decode_with_cache(params, config, cache, logits, 8)
         np.testing.assert_array_equal(np.asarray(one_shot),
                                       np.asarray(split))
+        # the split path keeps the one-shot path's loud overflow failure
+        with pytest.raises(ValueError, match="capacity"):
+            greedy_decode_with_cache(params, config, cache, logits, 32)
 
     def test_chunked_prefill_validates_tiling(self):
         from kubeshare_tpu.models.decoding import prefill_chunked
@@ -1280,6 +1283,90 @@ class TestShardedDecoding:
         mesh = make_mesh(MeshSpec(dp=2, tp=2, sp=2))
         with pytest.raises(ValueError, match=r"wk.*axis 1.*tp=2"):
             shard_params(params, transformer_sharding_rules(), mesh)
+
+
+class TestSpeculativeDecoding:
+    """Draft-model speculation must emit EXACTLY greedy_decode's tokens —
+    the acceptance rule preserves the target's argmax stream regardless
+    of how good or bad the draft is."""
+
+    def _target(self, **extra):
+        from kubeshare_tpu.models.transformer import (
+            TransformerConfig, transformer_init)
+
+        config = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq_len=64, dtype=jnp.float32, attention="reference",
+            **extra)
+        return config, transformer_init(jax.random.PRNGKey(0), config)
+
+    def test_self_draft_matches_greedy(self):
+        """Draft == target: every proposal accepted, output identical."""
+        from kubeshare_tpu.models.decoding import (
+            greedy_decode, speculative_greedy_decode)
+
+        config, params = self._target()
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 64)
+        base = greedy_decode(params, config, prompt, 12)
+        spec = speculative_greedy_decode(
+            params, config, params, config, prompt, 12, draft_len=4)
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(spec))
+
+    def test_bad_draft_still_matches_greedy(self):
+        """A differently-initialized (frequently wrong) draft changes only
+        the speed, never the tokens."""
+        from kubeshare_tpu.models.transformer import (
+            TransformerConfig, transformer_init)
+        from kubeshare_tpu.models.decoding import (
+            greedy_decode, speculative_greedy_decode)
+
+        config, params = self._target(positional="rope", n_kv_heads=2)
+        draft_config = TransformerConfig(
+            vocab_size=64, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+            max_seq_len=64, dtype=jnp.float32, attention="reference")
+        draft_params = transformer_init(jax.random.PRNGKey(9), draft_config)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 64)
+        base = greedy_decode(params, config, prompt, 12)
+        for draft_len in (2, 3, 5):
+            spec = speculative_greedy_decode(
+                params, config, draft_params, draft_config, prompt, 12,
+                draft_len=draft_len)
+            np.testing.assert_array_equal(
+                np.asarray(base), np.asarray(spec),
+                err_msg=f"draft_len={draft_len}")
+
+    def test_jits(self):
+        from kubeshare_tpu.models.decoding import speculative_greedy_decode
+
+        config, params = self._target()
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 4), 0, 64)
+        fn = jax.jit(lambda p, t: speculative_greedy_decode(
+            p, config, p, config, t, 8))
+        out1 = fn(params, prompt)
+        out2 = fn(params, prompt)
+        np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+        assert out1.shape == (1, 8)
+
+    def test_validation(self):
+        from kubeshare_tpu.models.transformer import (
+            TransformerConfig, transformer_init)
+        from kubeshare_tpu.models.decoding import speculative_greedy_decode
+
+        config, params = self._target()
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(ValueError, match="draft_len"):
+            speculative_greedy_decode(params, config, params, config,
+                                      prompt, 8, draft_len=1)
+        other_vocab = TransformerConfig(
+            vocab_size=32, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+            max_seq_len=64)
+        other_params = transformer_init(jax.random.PRNGKey(0), other_vocab)
+        with pytest.raises(ValueError, match="vocabular"):
+            speculative_greedy_decode(params, config, other_params,
+                                      other_vocab, prompt, 8)
+        with pytest.raises(ValueError, match="headroom"):
+            speculative_greedy_decode(params, config, params, config,
+                                      prompt, 60)
 
 
 class TestSampledDecoding:
