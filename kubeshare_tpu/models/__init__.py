@@ -18,6 +18,7 @@ from .decoding import (
     prefill,
     prefill_chunked,
     sample_decode,
+    sample_decode_with_cache,
     speculative_greedy_decode,
 )
 
@@ -33,6 +34,7 @@ __all__ = [
     "prefill",
     "prefill_chunked",
     "sample_decode",
+    "sample_decode_with_cache",
     "speculative_greedy_decode",
     "MnistConfig",
     "mnist_init",

@@ -30,6 +30,25 @@ def _check_moe_decodable(config: TransformerConfig) -> None:
         raise ValueError(f"unknown moe_routing {config.moe_routing!r}")
 
 
+def _check_cache_headroom(cache: Dict, max_new_tokens: int) -> None:
+    """The loud failure both cached decode splits share: past capacity,
+    dynamic_update_slice clamps and silently overwrites the last cache
+    slot.  Under jit the length is traced; the static bound still holds."""
+    capacity = cache["k"].shape[3]
+    length = cache["length"]
+    if not isinstance(length, jax.core.Tracer):
+        if int(length) + max_new_tokens > capacity:
+            raise ValueError(
+                f"cache length {int(length)} + max_new_tokens "
+                f"{max_new_tokens} exceeds the cache capacity {capacity}"
+            )
+    elif max_new_tokens > capacity:
+        raise ValueError(
+            f"max_new_tokens {max_new_tokens} exceeds the cache "
+            f"capacity {capacity}"
+        )
+
+
 def _check_prompt_fits(config: TransformerConfig, prompt_len: int) -> None:
     if prompt_len > config.max_seq_len:
         # dynamic_update_slice would silently clamp at the window edge
@@ -256,23 +275,7 @@ def greedy_decode_with_cache(
     """Greedy continuation from a prefilled cache — the serving split:
     prefill once (bulk or chunked), decode from its (cache, logits).
     Returns [batch, max_new_tokens] token ids; jit-compatible."""
-    capacity = cache["k"].shape[3]
-    length = cache["length"]
-    if not isinstance(length, jax.core.Tracer):
-        # same loud failure greedy_decode gives: past capacity,
-        # dynamic_update_slice clamps and silently overwrites the last
-        # cache slot
-        if int(length) + max_new_tokens > capacity:
-            raise ValueError(
-                f"cache length {int(length)} + max_new_tokens "
-                f"{max_new_tokens} exceeds the cache capacity {capacity}"
-            )
-    elif max_new_tokens > capacity:
-        # under jit the length is traced; at least the static bound holds
-        raise ValueError(
-            f"max_new_tokens {max_new_tokens} exceeds the cache "
-            f"capacity {capacity}"
-        )
+    _check_cache_headroom(cache, max_new_tokens)
     first_token = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
 
     def step(carry, _):
@@ -472,11 +475,37 @@ def sample_decode(
         )
     if temperature < 0.0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
-    # validate the filter arguments up front so temperature=0 rejects the
-    # same inputs the sampling path would
+    # validate the filter arguments BEFORE the prefill forward, so a bad
+    # top_k/top_p fails fast on every temperature
     _filter_logits(jnp.zeros((1, 2)), top_k, top_p)
     if temperature == 0.0:
         return greedy_decode(params, config, prompt, max_new_tokens)
+    cache, logits = prefill(params, config, prompt)
+    return sample_decode_with_cache(
+        params, config, cache, logits, rng, max_new_tokens,
+        temperature=temperature, top_k=top_k, top_p=top_p)
+
+
+def sample_decode_with_cache(
+    params,
+    config: TransformerConfig,
+    cache: Dict,
+    last_logits: jax.Array,
+    rng: jax.Array,
+    max_new_tokens: int,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> jax.Array:
+    """Sampled continuation from a prefilled cache (the serving split,
+    like :func:`greedy_decode_with_cache`)."""
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    _filter_logits(jnp.zeros((1, 2)), top_k, top_p)
+    if temperature == 0.0:
+        return greedy_decode_with_cache(params, config, cache, last_logits,
+                                        max_new_tokens)
+    _check_cache_headroom(cache, max_new_tokens)
 
     def pick(logits, key):
         # conventional order: temperature first, then the k/nucleus
@@ -485,9 +514,8 @@ def sample_decode(
         filtered = _filter_logits(logits / temperature, top_k, top_p)
         return jax.random.categorical(key, filtered, axis=-1).astype(jnp.int32)
 
-    cache, logits = prefill(params, config, prompt)
     rng, first_key = jax.random.split(rng)
-    first_token = pick(logits, first_key)
+    first_token = pick(last_logits, first_key)
 
     def step(carry, key):
         cache, token = carry

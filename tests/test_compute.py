@@ -1129,6 +1129,28 @@ class TestDecoding:
         with pytest.raises(ValueError, match="capacity"):
             greedy_decode_with_cache(params, config, cache, logits, 32)
 
+    def test_sampled_decode_from_cache_matches_one_shot(self):
+        """sample_decode == prefill + sample_decode_with_cache under the
+        same key (the sampled serving split)."""
+        from kubeshare_tpu.models.decoding import (
+            prefill, sample_decode, sample_decode_with_cache)
+        from kubeshare_tpu.models.transformer import (
+            TransformerConfig, transformer_init)
+
+        config = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq_len=32, dtype=jnp.float32, attention="reference")
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 64)
+        rng = jax.random.PRNGKey(7)
+        one_shot = sample_decode(params, config, prompt, rng, 6,
+                                 temperature=0.8, top_k=10)
+        cache, logits = prefill(params, config, prompt)
+        split = sample_decode_with_cache(params, config, cache, logits,
+                                         rng, 6, temperature=0.8, top_k=10)
+        np.testing.assert_array_equal(np.asarray(one_shot),
+                                      np.asarray(split))
+
     def test_chunked_prefill_validates_tiling(self):
         from kubeshare_tpu.models.decoding import prefill_chunked
         from kubeshare_tpu.models.transformer import (
