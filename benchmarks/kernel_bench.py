@@ -474,11 +474,69 @@ def suite_moe(iters, reps, quick=False):
     emit(row)
 
 
+def suite_chunk(iters, reps, quick=False):
+    """The width-C cached step vs C sequential single-token steps — the
+    structural win under BOTH chunked prefill and speculative decoding's
+    verify pass (end-to-end speculative tokens/s = this speedup composed
+    with the draft's acceptance rate, which depends on trained models a
+    synthetic bench cannot supply; output equivalence is test-locked in
+    TestSpeculativeDecoding / test_chunked_prefill_matches_bulk)."""
+    from kubeshare_tpu.models.decoding import _decode_chunk, init_kv_cache
+    from kubeshare_tpu.models.transformer import (
+        TransformerConfig, transformer_init)
+
+    if quick:
+        dims = dict(d_model=64, n_layers=2, n_heads=4, d_ff=128,
+                    vocab_size=512)
+        batch, widths = 1, (4,)
+    else:
+        dims = dict(d_model=1024, n_layers=8, n_heads=8, d_ff=4096,
+                    vocab_size=32000)
+        batch, widths = 1, (4, 8, 16)
+    config = TransformerConfig(max_seq_len=256, positional="rope",
+                               dtype=jnp.bfloat16, **dims)
+    params = transformer_init(jax.random.PRNGKey(0), config)
+    cache0 = init_kv_cache(config, batch)
+
+    for width in widths:
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, width),
+                                    0, dims["vocab_size"])
+
+        def chunk_step(carry):
+            cache, toks = carry
+            logits, cache = _decode_chunk(params, config, cache, toks)
+            # reset length so repeated applications stay in-bounds; feed
+            # argmax back so the chain has a data dependency
+            cache = dict(cache, length=jnp.zeros((), jnp.int32))
+            return cache, jnp.argmax(logits, -1).astype(jnp.int32)
+
+        def serial_step(carry):
+            cache, toks = carry
+
+            def one(cache, tok):
+                logits, cache = _decode_chunk(params, config, cache,
+                                              tok[:, None])
+                return cache, jnp.argmax(logits[:, 0], -1).astype(jnp.int32)
+
+            cache, out = jax.lax.scan(
+                lambda c, t: one(c, t), cache, toks.T)
+            cache = dict(cache, length=jnp.zeros((), jnp.int32))
+            return cache, out.T
+
+        chunk_ms = bench_op(chunk_step, (cache0, tokens), iters, reps)
+        serial_ms = bench_op(serial_step, (cache0, tokens), iters, reps)
+        emit({"suite": "chunk", "width": width, "dims": dims,
+              "batch": batch,
+              "chunk_ms": round(chunk_ms, 3),
+              "serial_ms": round(serial_ms, 3),
+              "chunk_speedup": ratio(serial_ms, chunk_ms)})
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--suite", default="all",
                         choices=("all", "fwd", "fwdbwd", "window", "ringstep",
-                                 "ringgrad", "model", "moe"))
+                                 "ringgrad", "model", "moe", "chunk"))
     parser.add_argument("--iters", type=int, default=30)
     parser.add_argument("--reps", type=int, default=3)
     parser.add_argument("--quick", action="store_true",
@@ -524,6 +582,8 @@ def main():
         suite_model(max(args.iters // 3, 3), args.reps, quick=args.quick)
     if args.suite in ("all", "moe"):
         suite_moe(max(args.iters // 3, 3), args.reps, quick=args.quick)
+    if args.suite in ("all", "chunk"):
+        suite_chunk(max(args.iters // 3, 3), args.reps, quick=args.quick)
 
 
 if __name__ == "__main__":

@@ -82,5 +82,9 @@ run 1800 "whole-model MFU" "$OUT/kernel_model.json" \
 gap
 run 1800 "MoE dispatch MFU (einsum vs scatter)" "$OUT/kernel_moe.json" \
     python benchmarks/kernel_bench.py --suite moe
+gap
+run 1200 "width-C cached step vs serial steps (prefill/speculation win)" \
+    "$OUT/kernel_chunk.json" \
+    python benchmarks/kernel_bench.py --suite chunk
 
 echo "== done; update docs/perf.md from $OUT =="
