@@ -14,7 +14,7 @@ distributed-workload half the prompt makes first-class.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -297,10 +297,11 @@ def transformer_apply_with_aux(
 
 
 def _validate_sp_entry(
-    strategy: str, config: TransformerConfig, mesh: Mesh, seq_axis: str
+    strategy: str, config: TransformerConfig, mesh: Mesh, seq_axis: str,
 ) -> None:
     """Shared preconditions for every sequence-parallel entry point (the
-    standalone ring/ulysses forwards and the pipelined sp path)."""
+    standalone ring/ulysses forwards and the pipelined sp path; the
+    pipelined caller adds its own MoE rejection — no aux plumbing)."""
     if seq_axis not in mesh.shape:
         raise ValueError(
             f"sequence-parallel attention needs a {seq_axis!r} mesh axis "
@@ -318,12 +319,23 @@ def _validate_sp_entry(
             f"n_kv_heads ({config.kv_heads}) divisible by the "
             f"{seq_axis!r} mesh degree ({mesh.shape[seq_axis]})"
         )
-    if config.moe_every is not None:
+    if (config.moe_every is not None
+            and config.moe_routing == "experts_choose"):
         raise ValueError(
-            "MoE layers are not supported on the sequence-parallel / "
-            "pipelined paths yet (per-shard routing capacity and aux-loss "
-            "reduction need their own design); use the dense entry points"
+            "expert-choice routing is whole-batch routing (an expert picks "
+            "its top-capacity tokens globally, ops/moe.py) — a sequence "
+            "shard cannot route it locally; use moe_routing="
+            "'tokens_choose' on the sequence-parallel entries"
         )
+
+
+def _mesh_mean_aux(aux, batch_axis, seq_axis):
+    """Average a per-shard MoE aux loss over the mesh axes the entry
+    shards on, so the returned scalar is replicated."""
+    aux = jax.lax.pmean(aux, seq_axis)
+    if batch_axis is not None:
+        aux = jax.lax.pmean(aux, batch_axis)
+    return aux
 
 
 def transformer_apply_ring(
@@ -336,9 +348,17 @@ def transformer_apply_ring(
     use_flash: Optional[bool] = None,
     interpret: bool = False,
     layout: str = "contiguous",
-) -> jax.Array:
+    with_aux: bool = False,
+) -> Union[jax.Array, Tuple[jax.Array, jax.Array]]:
     """Sequence-parallel forward: tokens sharded over ``seq_axis``, ring
     attention carrying K/V around the ICI ring (long-context path).
+
+    MoE configs route each sequence shard's tokens locally (routing is
+    per-token; expert buffers derive from the shard's token count).
+    ``with_aux=True`` additionally returns the load-balancing aux loss,
+    averaged over the mesh — a per-shard-mean estimator of the dense
+    entry's global-mean aux (identical in expectation under balanced
+    shard sizes).
 
     ``use_flash=None`` auto-selects the Pallas-fused ring body on TPU when
     the per-device sequence shard reaches the kernel threshold (the kernel
@@ -401,15 +421,15 @@ def transformer_apply_ring(
                 )
         # zigzag: return hidden states and project outside — the inverse
         # permutation then moves d_model-wide rows, not vocab-wide logits
-        out, _ = _forward(params, tokens, config, attention_fn, pos,
-                          apply_head=not zigzag)
-        return out
+        out, aux = _forward(params, tokens, config, attention_fn, pos,
+                            apply_head=not zigzag)
+        return out, _mesh_mean_aux(aux, batch_axis, seq_axis)
 
-    out = jax.shard_map(
+    out, aux = jax.shard_map(
         local_forward,
         mesh=mesh,
         in_specs=(P(), P(batch_axis, seq_axis)),
-        out_specs=P(batch_axis, seq_axis, None),
+        out_specs=(P(batch_axis, seq_axis, None), P()),
         # only interpret-mode pallas evaluation trips the vma checker (its
         # block slicing mixes varying/invariant operands); the compiled TPU
         # kernel path keeps full checking over the whole forward
@@ -419,7 +439,7 @@ def transformer_apply_ring(
         hidden = zigzag_unshard(out, sp, axis=1)
         out = (hidden @ params["lm_head"].astype(config.dtype)).astype(
             jnp.float32)
-    return out
+    return (out, aux) if with_aux else out
 
 
 def transformer_apply_ulysses(
@@ -431,7 +451,8 @@ def transformer_apply_ulysses(
     seq_axis: str = "sp",
     use_flash: Optional[bool] = None,
     interpret: bool = False,
-) -> jax.Array:
+    with_aux: bool = False,
+) -> Union[jax.Array, Tuple[jax.Array, jax.Array]]:
     """Sequence-parallel forward via all-to-all (Ulysses-style) attention:
     tokens sharded over ``seq_axis``; two ``all_to_all`` collectives swap
     the shards to head-parallel for a FULL-sequence local attention (the
@@ -440,7 +461,8 @@ def transformer_apply_ulysses(
     Supports ``attention_window`` (the all-to-all hands each device whole
     heads over the whole sequence, so the flash kernel's banding applies
     directly; the ring composes with windows too, via its einsum body);
-    needs ``n_heads % mesh.shape[seq_axis] == 0``."""
+    needs ``n_heads % mesh.shape[seq_axis] == 0``.  MoE and ``with_aux``
+    behave as on :func:`transformer_apply_ring`."""
     from ..ops.ulysses import ulysses_attention
 
     _validate_sp_entry("ulysses", config, mesh, seq_axis)
@@ -453,17 +475,18 @@ def transformer_apply_ulysses(
             window=config.attention_window, use_flash=use_flash,
             interpret=interpret,
         )
-        logits, _ = _forward(params, tokens, config, attention_fn, offset)
-        return logits
+        logits, aux = _forward(params, tokens, config, attention_fn, offset)
+        return logits, _mesh_mean_aux(aux, batch_axis, seq_axis)
 
     force_flash = use_flash if use_flash is not None else interpret
-    return jax.shard_map(
+    out, aux = jax.shard_map(
         local_forward,
         mesh=mesh,
         in_specs=(P(), P(batch_axis, seq_axis)),
-        out_specs=P(batch_axis, seq_axis, None),
+        out_specs=(P(batch_axis, seq_axis, None), P()),
         check_vma=not (force_flash and interpret),
     )(params, tokens)
+    return (out, aux) if with_aux else out
 
 
 def transformer_sharding_rules() -> Dict[str, P]:
