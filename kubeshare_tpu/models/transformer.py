@@ -357,8 +357,9 @@ def transformer_apply_ring(
     per-token; expert buffers derive from the shard's token count).
     ``with_aux=True`` additionally returns the load-balancing aux loss,
     averaged over the mesh — a per-shard-mean estimator of the dense
-    entry's global-mean aux (identical in expectation under balanced
-    shard sizes).
+    entry's global-mean aux (biased by the per-shard covariance of the
+    aux's two mean factors: a usable load-balancing signal, not exact
+    loss parity with the dense entry).
 
     ``use_flash=None`` auto-selects the Pallas-fused ring body on TPU when
     the per-device sequence shard reaches the kernel threshold (the kernel
