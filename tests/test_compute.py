@@ -1854,16 +1854,25 @@ class TestMoEFlagship:
         np.testing.assert_allclose(np.asarray(base), np.asarray(sharded),
                                    rtol=2e-4, atol=2e-4)
 
-    def test_sp_paths_reject_moe(self):
+    def test_sp_entries_accept_token_choice_moe(self):
+        """Round 4: the standalone sp entries route MoE per shard
+        (TestMoESequenceParallel locks dense equivalence); only
+        expert-choice routing — whole-batch by construction — is
+        rejected there."""
+        from dataclasses import replace
+
         from kubeshare_tpu.models.transformer import transformer_apply_ring
 
         config = self._config(attention="ring")
-        params_cfg = self._config()
-        params = transformer_init(jax.random.PRNGKey(0), params_cfg)
+        params = transformer_init(jax.random.PRNGKey(0), self._config())
         mesh = make_mesh(MeshSpec(dp=2, tp=1, sp=4))
-        with pytest.raises(ValueError, match="MoE"):
+        out = transformer_apply_ring(params, jnp.zeros((2, 8), jnp.int32),
+                                     config, mesh)
+        assert np.isfinite(np.asarray(out)).all()
+        ec = replace(config, moe_routing="experts_choose")
+        with pytest.raises(ValueError, match="whole-batch"):
             transformer_apply_ring(params, jnp.zeros((2, 8), jnp.int32),
-                                   config, mesh)
+                                   ec, mesh)
 
     @pytest.mark.parametrize("attention", ["reference", "ring"])
     def test_pipelined_paths_reject_moe(self, attention):
