@@ -1,0 +1,17 @@
+#!/bin/bash
+# Hourly TPU tunnel probe. Writes benchmarks/out/probe_status.json on each attempt;
+# on first success writes benchmarks/out/TUNNEL_UP and exits so the builder can recapture.
+cd /root/repo
+while true; do
+  ts=$(date -u +%FT%TZ)
+  if timeout 90 python -c "import jax; d=jax.devices(); assert d and d[0].platform=='tpu', d; print(d)" >/tmp/probe_out.txt 2>&1; then
+    echo "{\"ts\": \"$ts\", \"ok\": true}" > benchmarks/out/probe_status.json
+    touch benchmarks/out/TUNNEL_UP
+    echo "$ts TUNNEL UP" >> benchmarks/out/probe_log.txt
+    exit 0
+  else
+    echo "{\"ts\": \"$ts\", \"ok\": false}" > benchmarks/out/probe_status.json
+    echo "$ts probe failed/hung" >> benchmarks/out/probe_log.txt
+  fi
+  sleep 3300
+done

@@ -109,9 +109,12 @@ def main() -> None:
         # weights in as XLA constants (slow compiles, duplicated memory)
         prefill_fn = jax.jit(
             lambda w, p: prefill_chunked(w, config, p, chunk=32))
+        # prefill_length is STATIC under jit: it lets the decode validate
+        # prompt+new tokens against cache capacity at trace time (the
+        # traced cache length can't be checked then)
         decode_fn = jax.jit(
             lambda w, cache, logits: greedy_decode_with_cache(
-                w, config, cache, logits, 32))
+                w, config, cache, logits, 32, prefill_length=64))
         # warm the compile caches outside the gated window
         warm_cache, warm_logits = prefill_fn(params, prompts[0])
         jax.block_until_ready(decode_fn(params, warm_cache, warm_logits))

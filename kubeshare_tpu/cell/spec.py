@@ -28,6 +28,10 @@ class CellTypeSpec:
     child_cell_number: int = 0
     child_cell_priority: int = 0
     is_node_level: bool = False
+    # marks the ICI-domain level: cells of this type are one slice; anything
+    # grouping them sits across DCN.  Unmarked topologies treat each root
+    # physical cell as a slice (see topology.slice_key).
+    is_slice_level: bool = False
 
     @staticmethod
     def from_dict(d: dict) -> "CellTypeSpec":
@@ -36,6 +40,7 @@ class CellTypeSpec:
             child_cell_number=int(d.get("childCellNumber", 0)),
             child_cell_priority=int(d.get("childCellPriority", 0)),
             is_node_level=bool(d.get("isNodeLevel", False)),
+            is_slice_level=bool(d.get("isSliceLevel", False)),
         )
 
 

@@ -71,6 +71,26 @@ def ici_distance(
     return total
 
 
+def slice_key(cell, slice_types: frozenset = frozenset()) -> str:
+    """ICI-domain identity of a cell: the id of its nearest ancestor (or
+    self) whose type is marked ``isSliceLevel``, else the root physical
+    cell's id.  Two cells with different slice keys reach each other over
+    DCN, not ICI — the scorer charges a flat DCN tier between them and the
+    scheduler injects megascale bootstrap env for gangs that span keys
+    (SURVEY §5: megascale/DCN flags are part of the visibility-env
+    mandate; the reference's string-path heuristic, score.go:164-227, had
+    no such tier).
+    """
+    top = cell
+    node = cell
+    while node is not None:
+        if node.cell_type in slice_types:
+            return node.id
+        top = node
+        node = node.parent
+    return top.id
+
+
 def cell_id_distance(current: Sequence[str], other_id: str) -> float:
     """Reference-compatible locality distance over slash-path cell IDs
     (ref score.go:164-227): align segments from the end; numeric segments
@@ -166,6 +186,10 @@ def chip_box(coords: Sequence[Optional[Sequence[int]]], n_chips: int) -> str:
     if len(known) != n_chips or n_chips == 0:
         return f"{max(n_chips, 1)},1,1"
     ndim = max(len(c) for c in known)
+    if ndim > 3:
+        # the bounds syntax is 3-D; truncating a >3-D box that tiles in
+        # full ndim could emit a bound whose volume != n_chips (ADVICE r4)
+        return f"{n_chips},1,1"
     padded = [tuple(c) + (0,) * (ndim - len(c)) for c in known]
     lows = [min(c[i] for c in padded) for i in range(ndim)]
     highs = [max(c[i] for c in padded) for i in range(ndim)]

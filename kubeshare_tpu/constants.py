@@ -37,6 +37,15 @@ ENV_SHIM_PRELOAD = "LD_PRELOAD"
 ENV_MEM_FRACTION = "TPUSHARE_MEM_FRACTION"  # HBM cap as fraction of chip HBM
 ENV_MEM_BYTES = "TPUSHARE_MEM_BYTES"  # HBM cap in bytes
 
+# multi-slice (DCN) bootstrap env for gangs whose cells span ICI domains
+# (SURVEY §5: megascale flags are part of the visibility-env mandate).
+# Names are libtpu's own so a pod's runtime picks them up directly.
+ENV_MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
+ENV_MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
+ENV_MEGASCALE_COORDINATOR = "MEGASCALE_COORDINATOR_ADDRESS"
+ENV_MEGASCALE_PORT = "MEGASCALE_PORT"
+MEGASCALE_DEFAULT_PORT = 8477  # beside the jax.distributed coordinator's 8476
+
 # ---- filesystem layout on the node (hostPath bus, ref /kubeshare/...) ----
 ROOT_DIR = "/kubeshare"
 LIBRARY_PATH = ROOT_DIR + "/library"  # ref pod.go:25

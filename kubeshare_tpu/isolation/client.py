@@ -28,6 +28,7 @@ class TokenClient:
         self.timeout = timeout
         self._sock: Optional[socket.socket] = None
         self._file = None
+        self._blocking_ok = True  # cleared when the daemon lacks REQB
 
     # -- wire ----------------------------------------------------------
     def _connect(self) -> None:
@@ -54,20 +55,45 @@ class TokenClient:
         raise ConnectionError(f"token endpoint {self.host}:{self.port} unreachable")
 
     # -- protocol ------------------------------------------------------
-    def acquire(self, est_ms: float = 0.0) -> float:
-        """Poll until granted a compute token; returns the quota in ms.
+    # server-side park per blocking request; re-issued until granted
+    BLOCKING_WINDOW_MS = 2000.0
 
-        The broker answers ``TOK <quota>`` or ``WAIT <retry_ms>`` (REQ is
-        non-blocking server-side; see native/tokend.cc protocol notes) —
-        the wait loop lives in the client."""
+    def acquire(self, est_ms: float = 0.0) -> float:
+        """Block until granted a compute token; returns the quota in ms.
+
+        Uses the long-poll ``REQB`` verb: this client sends RET from the
+        same synchronous step loop (never from a runtime callback), so
+        the connection can safely park server-side and the handoff is
+        event-driven — a released token wakes this waiter immediately
+        instead of at a poll tick (the polling alternative measurably
+        costs the co-run bench on a serial-core host; tokend.cc protocol
+        notes).  Falls back to ``REQ`` polling against an older daemon
+        that answers ``ERR`` for REQB."""
         import time
 
         while True:
-            reply = self._round_trip(f"REQ {self.pod_name} {est_ms:.3f}\n")
+            start = time.monotonic()
+            if self._blocking_ok:
+                reply = self._round_trip(
+                    f"REQB {self.pod_name} {est_ms:.3f} "
+                    f"{self.BLOCKING_WINDOW_MS:.0f}\n")
+                if reply.startswith("ERR"):
+                    self._blocking_ok = False
+                    continue
+            else:
+                reply = self._round_trip(f"REQ {self.pod_name} {est_ms:.3f}\n")
             if reply.startswith("TOK "):
                 return float(reply[4:])
             if reply.startswith("WAIT "):
-                time.sleep(min(0.1, max(0.001, float(reply[5:]) / 1e3)))
+                # A WAIT that came back well before the park window means
+                # the server answered poll-shaped — an old daemon (REQ) or
+                # a gang-gated one (-G degrades REQB to REQ; peer
+                # consultation cannot park).  Honor the retry hint there;
+                # a WAIT after a full park re-issues immediately.
+                elapsed_ms = (time.monotonic() - start) * 1e3
+                if (not self._blocking_ok
+                        or elapsed_ms < self.BLOCKING_WINDOW_MS / 2):
+                    time.sleep(min(0.1, max(0.001, float(reply[5:]) / 1e3)))
                 continue
             raise ConnectionError(f"unexpected token reply: {reply!r}")
 

@@ -30,19 +30,39 @@ def _check_moe_decodable(config: TransformerConfig) -> None:
         raise ValueError(f"unknown moe_routing {config.moe_routing!r}")
 
 
-def _check_cache_headroom(cache: Dict, max_new_tokens: int) -> None:
+def _check_cache_headroom(cache: Dict, max_new_tokens: int,
+                          prefill_length: Optional[int] = None) -> None:
     """The loud failure both cached decode splits share: past capacity,
     dynamic_update_slice clamps and silently overwrites the last cache
-    slot.  Under jit the length is traced; the static bound still holds."""
+    slot.
+
+    Outside jit the concrete cache length is checked directly.  Under jit
+    the length is a tracer and the full bound cannot be evaluated at trace
+    time — callers jitting a ``*_with_cache`` continuation (the headline
+    serving pattern, examples/serve_fractional.py) must pass their static
+    ``prefill_length`` so the real bound is enforced; without it only the
+    weaker ``max_new_tokens <= capacity`` check applies and a continuation
+    from a nearly-full cache can silently overwrite the last slot
+    (ADVICE r4 medium)."""
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     capacity = cache["k"].shape[3]
     length = cache["length"]
+    if prefill_length is not None and prefill_length + max_new_tokens > capacity:
+        raise ValueError(
+            f"prefill_length {prefill_length} + max_new_tokens "
+            f"{max_new_tokens} exceeds the cache capacity {capacity}"
+        )
+    # the concrete-length check applies INDEPENDENTLY of prefill_length:
+    # outside jit the cache's real length is authoritative (a caller
+    # passing an understated prefill_length must still fail loudly)
     if not isinstance(length, jax.core.Tracer):
         if int(length) + max_new_tokens > capacity:
             raise ValueError(
                 f"cache length {int(length)} + max_new_tokens "
                 f"{max_new_tokens} exceeds the cache capacity {capacity}"
             )
-    elif max_new_tokens > capacity:
+    elif prefill_length is None and max_new_tokens > capacity:
         raise ValueError(
             f"max_new_tokens {max_new_tokens} exceeds the cache "
             f"capacity {capacity}"
@@ -271,11 +291,17 @@ def greedy_decode_with_cache(
     cache: Dict,
     last_logits: jax.Array,
     max_new_tokens: int,
+    prefill_length: Optional[int] = None,
 ) -> jax.Array:
     """Greedy continuation from a prefilled cache — the serving split:
     prefill once (bulk or chunked), decode from its (cache, logits).
-    Returns [batch, max_new_tokens] token ids; jit-compatible."""
-    _check_cache_headroom(cache, max_new_tokens)
+    Returns [batch, max_new_tokens] token ids; jit-compatible.
+
+    When this call is jitted (cache length traced), pass the static
+    ``prefill_length`` so the capacity bound is enforced at trace time —
+    without it, a continuation from a nearly-full cache cannot be
+    caught and would clamp-overwrite the last cache slot."""
+    _check_cache_headroom(cache, max_new_tokens, prefill_length)
     first_token = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
 
     def step(carry, _):
@@ -320,8 +346,12 @@ def speculative_greedy_decode(
     max_new_tokens: int,
     draft_len: int = 4,
 ) -> jax.Array:
-    """Greedy generation with draft-model speculation: emits EXACTLY the
-    tokens :func:`greedy_decode` would, in fewer target-model passes.
+    """Greedy generation with draft-model speculation: matches
+    :func:`greedy_decode`'s token stream up to floating-point argmax
+    ties, in fewer target-model passes.  (The width-``draft_len`` verify
+    chunk reduces its matmuls in a different order than width-1 steps, so
+    a near-tied argmax can diverge on real hardware — bf16 especially;
+    the equivalence tests lock exactness on CPU f32 small models.)
 
     Each round the draft proposes ``draft_len - 1`` tokens one at a time
     (cheap model, tiny steps), then the target verifies the whole
@@ -339,6 +369,8 @@ def speculative_greedy_decode(
     the next round.  Both models must share a vocabulary; the caches
     need headroom of ``draft_len`` beyond the generated text."""
     batch, prompt_len = prompt.shape
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     if draft_len < 2:
         raise ValueError(f"draft_len must be >= 2, got {draft_len}")
     if config.vocab_size != draft_config.vocab_size:
@@ -496,16 +528,18 @@ def sample_decode_with_cache(
     temperature: float = 1.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    prefill_length: Optional[int] = None,
 ) -> jax.Array:
     """Sampled continuation from a prefilled cache (the serving split,
-    like :func:`greedy_decode_with_cache`)."""
+    like :func:`greedy_decode_with_cache`).  Jitted callers should pass
+    the static ``prefill_length`` — see greedy_decode_with_cache."""
     if temperature < 0.0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     _filter_logits(jnp.zeros((1, 2)), top_k, top_p)
     if temperature == 0.0:
         return greedy_decode_with_cache(params, config, cache, last_logits,
-                                        max_new_tokens)
-    _check_cache_headroom(cache, max_new_tokens)
+                                        max_new_tokens, prefill_length)
+    _check_cache_headroom(cache, max_new_tokens, prefill_length)
 
     def pick(logits, key):
         # conventional order: temperature first, then the k/nucleus

@@ -488,8 +488,11 @@ def zigzag_permutation(seq_len: int, sp: int):
 
 
 # traced calls of the zigzag wrapper (misuse visibility; see
-# ring_attention_sharded)
+# ring_attention_sharded).  Process-cumulative by design: it cannot
+# distinguish per-layer misuse from two independent models (or a retrace
+# for new shapes) each tracing once — the warning text says so (ADVICE r4)
 _zigzag_traced_calls = 0
+_zigzag_counter_lock = __import__("threading").Lock()
 
 
 def zigzag_traced_calls() -> int:
@@ -808,15 +811,19 @@ def ring_attention_sharded(
         # per-layer all-to-all.  Count traced calls so the misuse is
         # visible (ADVICE r3); the permute-once path is in the docstring.
         global _zigzag_traced_calls
-        _zigzag_traced_calls += 1
-        if _zigzag_traced_calls == 2:
+        with _zigzag_counter_lock:
+            _zigzag_traced_calls += 1
+            warn = _zigzag_traced_calls == 2
+        if warn:
             from ..utils.logger import get_logger
 
             get_logger("kubeshare-ops").warning(
                 "ring_attention_sharded(layout='zigzag') traced more than "
                 "once in this process — every call permutes globally twice; "
-                "multi-layer models should permute once (zigzag_shard at "
-                "embedding) and call the in-shard ring entry points"
+                "a multi-layer model calling it per layer should permute "
+                "once (zigzag_shard at embedding) and use the in-shard ring "
+                "entry points.  (Two separate models, or a retrace for new "
+                "shapes, also reach this count — ignore if that is the case.)"
             )
     if use_flash is None:
         use_flash = ring_flash_auto(q.shape[2], mesh, seq_axis, interpret,

@@ -28,7 +28,7 @@ from ..cell.cell import Cell
 from ..cell.element import build_cell_chains
 from ..cell.cell import build_cell_forest
 from ..cell.spec import TopologyConfig
-from ..cell.topology import cell_id_distance, ici_distance
+from ..cell.topology import cell_id_distance, ici_distance, slice_key
 from ..cluster.api import Clock, ClusterAPI, Node, Pod, PodPhase
 from ..utils.bitmap import RRBitmap
 from ..utils.logger import get_logger
@@ -95,6 +95,12 @@ class KubeShareScheduler:
         self.allocator = CellAllocator(forest, chip_priority)
         self.chip_priority = chip_priority
         self.sorted_models = sorted_models
+        # ICI-domain (slice) boundaries for DCN-tiered locality + megascale
+        # env injection: explicitly marked types, else each root cell
+        self.slice_types = frozenset(
+            name for name, t in topology.cell_types.items()
+            if getattr(t, "is_slice_level", False)
+        )
 
         self.pod_status: Dict[str, PodStatus] = {}
         self.pod_status_lock = threading.RLock()
@@ -442,12 +448,28 @@ class KubeShareScheduler:
                 for cell in ps.cells
             ]
 
+    # One DCN crossing costs more than any intra-slice spread: the largest
+    # current slice is a few hundred ICI hops across, and the reference's
+    # path heuristic charged 100 per crossed tree level (score.go:200-227),
+    # so a flat 1000 keeps every cross-slice candidate strictly behind every
+    # same-slice one while inter-slice id distance still breaks ties.
+    DCN_CROSSING_COST = 1000.0
+
     def cell_distance(self, a: Cell, b: Cell) -> float:
-        """ICI hop distance when mesh coords are known for both cells, else
-        the reference's cell-ID path distance (SURVEY §7.2)."""
+        """Tiered locality (SURVEY §7.2, §5): ICI hop distance when mesh
+        coords are known for both cells, else the reference's cell-ID path
+        distance — but cells in different ICI domains (slices) first pay a
+        flat DCN tier the reference's string heuristic never modeled."""
+        if self.slice_of(a) != self.slice_of(b):
+            return self.DCN_CROSSING_COST + cell_id_distance(
+                a.id.split("/"), b.id
+            )
         if a.coords is not None and b.coords is not None:
             return ici_distance(a.coords, b.coords)
         return cell_id_distance(a.id.split("/"), b.id)
+
+    def slice_of(self, cell: Cell) -> str:
+        return slice_key(cell, self.slice_types)
 
     def normalize_scores(self, scores: Dict[str, float]) -> Dict[str, int]:
         """ref scheduler.go:443-487."""
@@ -680,7 +702,7 @@ class KubeShareScheduler:
             ENV_GANG_SIZE,
         )
 
-        return {
+        env = {
             ENV_GANG_NAME: status.pod_group,
             ENV_GANG_SIZE: str(size),
             ENV_GANG_RANK: str(rank),
@@ -695,6 +717,102 @@ class KubeShareScheduler:
             constants.ENV_CHIPS_PER_PROCESS_BOUNDS:
                 f"{max(len(status.cells), 1)},1,1",
         }
+        if status.cells and key:
+            # DCN layout: planned once at the gang's first chip-bearing
+            # Reserve, then each member reads its slice assignment.  A
+            # single-slice gang (the common case, and what the DCN-tiered
+            # score steers toward) gets no megascale env at all.
+            home = self.slice_of(status.cells[0])
+            if not info.slice_plan:
+                self.pod_groups.set_slice_plan(
+                    key, self._plan_gang_slices(status, size, home)
+                )
+            elif home not in info.slice_plan:
+                self.log.warning(
+                    "gang %s member %s landed in slice %s outside the "
+                    "planned layout %s; appending (earlier members' "
+                    "MEGASCALE_NUM_SLICES is stale — their pods must be "
+                    "recreated for multi-slice init to agree)",
+                    key, pod.key, home, dict(info.slice_plan),
+                )
+            slice_id, num_slices, members, uniform = (
+                self.pod_groups.slice_assignment(key, home)
+            )
+            if num_slices > 1 and uniform:
+                # the TPU process grid is per-ICI-domain under megascale:
+                # each slice runs its own linear grid of that slice's
+                # members; the slice ids and the shared coordinator (same
+                # rank-0 headless-service convention the jax.distributed
+                # bootstrap uses, parallel/distributed.py) stitch the
+                # slices together over DCN
+                env[constants.ENV_PROCESS_BOUNDS] = f"{members},1,1"
+                env[constants.ENV_MEGASCALE_NUM_SLICES] = str(num_slices)
+                env[constants.ENV_MEGASCALE_SLICE_ID] = str(slice_id)
+                env[constants.ENV_MEGASCALE_COORDINATOR] = (
+                    f"{status.pod_group}-0.{status.pod_group}:"
+                    f"{constants.MEGASCALE_DEFAULT_PORT}"
+                )
+                env[constants.ENV_MEGASCALE_PORT] = str(
+                    constants.MEGASCALE_DEFAULT_PORT
+                )
+        return env
+
+    def _plan_gang_slices(
+        self, status: PodStatus, size: int, home: str
+    ) -> Dict[str, int]:
+        """Greedy fewest-slices layout for a gang of ``size`` members, each
+        needing ``len(status.cells)`` whole chips on one node: fill the
+        placing member's slice first, then remaining slices by free
+        capacity.  Capacity is counted in whole free leaves of the gang's
+        chip model at plan time — the plan is a bootstrap-env contract
+        (slice ids / counts), not a reservation; actual placement stays
+        with Filter/Score, which the DCN tier already points at the plan's
+        preference."""
+        chips_per_member = max(len(status.cells), 1)
+        model = status.cells[0].cell_type if status.cells else ""
+        per_node: Dict[Tuple[str, str], int] = {}
+        with self.allocator.lock:
+            if model in self.allocator.free_list:
+                levels = [self.allocator.free_list[model]]
+            else:
+                levels = list(self.allocator.free_list.values())
+            for by_level in levels:
+                for roots in by_level.values():
+                    for root in roots:
+                        for leaf in root.leaves():
+                            if leaf.healthy and leaf.available >= 0.999:
+                                k = (self.slice_of(leaf), leaf.node)
+                                per_node[k] = per_node.get(k, 0) + 1
+        caps: Dict[str, int] = {}
+        for (skey, _node), free in per_node.items():
+            caps[skey] = caps.get(skey, 0) + free // chips_per_member
+        # the placing member's own chips are already reserved, so its
+        # slice holds at least this one member
+        caps[home] = caps.get(home, 0) + 1
+        # libtpu multi-slice requires IDENTICALLY-shaped slices: every
+        # member's per-slice process grid must agree, so the plan is the
+        # smallest k with size % k == 0 where the home slice plus the
+        # k-1 roomiest others each hold size/k members.  An uneven split
+        # is not a viable bootstrap layout at all.
+        order = [home] + sorted(
+            (k for k in caps if k != home), key=lambda k: (-caps[k], k)
+        )
+        for k in range(1, len(order) + 1):
+            if size % k:
+                continue
+            per = size // k
+            if all(caps.get(s, 0) >= per for s in order[:k]):
+                return {s: per for s in order[:k]}
+        # no uniform layout fits the current capacity: plan single-slice
+        # (no megascale env; Filter/Score still place the members where
+        # they fit, and any off-plan member degrades the gang to the
+        # linear gang-wide grid via the uniformity gate in _gang_env)
+        self.log.warning(
+            "gang slice plan: no uniform %d-member layout fits current "
+            "per-slice capacity %s; planning single-slice on %s",
+            size, caps, home,
+        )
+        return {home: size}
 
     # ------------------------------------------------------------------
     # Permit: the gang barrier (ref scheduler.go:551-587)

@@ -61,6 +61,15 @@ class PodGroupInfo:
     # restart never duplicates a surviving peer's TPUSHARE_GANG_RANK
     # (jax.distributed process_id must be unique per gang).
     assigned_ranks: Dict[str, int] = field(default_factory=dict)
+    # slice key -> planned member count, insertion-ordered: the gang's
+    # DCN layout, planned once at its first chip-bearing Reserve from
+    # current per-slice capacity (fewest slices win; the placing member's
+    # slice is slice 0).  A member's MEGASCALE_SLICE_ID is its key's
+    # position in this dict; MEGASCALE_NUM_SLICES is its length.  The env
+    # of already-bound members is immutable, so the plan is sticky: a
+    # later member landing outside it is appended with a warning (the
+    # DCN-tiered score makes that a pathological case).
+    slice_plan: Dict[str, int] = field(default_factory=dict)
 
 
 class PodGroupRegistry:
@@ -148,6 +157,34 @@ class PodGroupRegistry:
                     )
             info.assigned_ranks[pod_key] = rank
             return rank
+
+    def set_slice_plan(self, key: str, plan: Dict[str, int]) -> None:
+        """Install the gang's DCN layout; first plan wins (sticky — bound
+        members' env is immutable)."""
+        with self._lock:
+            info = self._groups.get(key)
+            if info is not None and not info.slice_plan:
+                info.slice_plan.update(plan)
+
+    def slice_assignment(
+        self, key: str, slice_key: str
+    ) -> Tuple[int, int, int, bool]:
+        """Returns (slice_id, num_slices, planned members in that slice,
+        uniform) for a member placed in ``slice_key``.  A slice outside
+        the plan is appended (placement deviated; the caller warns).
+        ``uniform`` is whether every slice holds the same member count —
+        libtpu multi-slice requires identically-shaped slices, so the
+        caller emits megascale env only for uniform plans."""
+        with self._lock:
+            info = self._groups.get(key)
+            if info is None:
+                return 0, 1, 1, True
+            if slice_key not in info.slice_plan:
+                info.slice_plan[slice_key] = 1
+            keys = list(info.slice_plan)
+            uniform = len(set(info.slice_plan.values())) == 1
+            return (keys.index(slice_key), len(keys),
+                    info.slice_plan[slice_key], uniform)
 
     def release_rank(self, key: str, pod_key: str) -> None:
         with self._lock:

@@ -316,6 +316,190 @@ PJRT_Error* HookedBufferDestroy(PJRT_Buffer_Destroy_Args* args) {
 }
 
 // -----------------------------------------------------------------------
+// Async host-to-device transfer-manager accounting (VERDICT r4 #2): newer
+// JAX device_put paths allocate through
+// PJRT_Client_CreateBuffersForAsyncHostToDevice + TransferData instead of
+// BufferFromHostBuffer — without these hooks a pod uploads unmetered.
+// Allocation happens at CREATE (the manager pre-allocates every requested
+// shape before any TransferData), so the full byte size of all shapes is
+// charged there and an over-cap create is denied like an upload.
+// RetrieveBuffer moves each buffer's share of the charge onto the regular
+// per-buffer ledger so Buffer_Destroy credits it; TransferManager_Destroy
+// credits whatever was never retrieved.  Charge/credit stays symmetric:
+// only bytes this shim charged are ever credited.
+// -----------------------------------------------------------------------
+
+struct TransferManagerCharge {
+  std::vector<long long> per_buffer;  // -1 once retrieved
+  long long remaining = 0;            // sum of unretrieved entries
+};
+std::unordered_map<const void*, TransferManagerCharge>& TransferManagers() {
+  static auto* tms =
+      new std::unordered_map<const void*, TransferManagerCharge>;
+  return *tms;  // guarded by g_mem_mu; leaked: see RetiredEvents
+}
+
+// Host regions pinned device-visible via PJRT_Client_DmaMap.  Charged
+// against the same cap: the mapping is device-addressable staging a pod
+// could otherwise route unbounded data through (Gemini's posture was cap
+// EVERY alloc, ref pod.go:446-449 chain); soft mode logs instead.
+std::unordered_map<const void*, long long>& DmaMapped() {
+  static auto* mapped = new std::unordered_map<const void*, long long>;
+  return *mapped;  // guarded by g_mem_mu; leaked: see RetiredEvents
+}
+
+// Shared deny-or-charge preamble for the upload-shaped paths (upload,
+// async create, dma map): returns false when the request must be denied
+// (hard mode, over cap); *charged says whether the broker recorded it.
+bool ChargeUploadBytes(long long bytes, const char* what, bool* charged) {
+  *charged = false;
+  long long overflow = OverflowBytes();
+  if (overflow > 0 && !g_mem_soft) {
+    std::fprintf(stderr,
+                 "tpushim: tpushare: HBM cap exceeded: pod is %lld bytes "
+                 "over its gpu_mem cap (executable outputs); %lld-byte %s "
+                 "denied\n", overflow, bytes, what);
+    return false;
+  }
+  int rc = tpushare_mem_request(bytes);
+  *charged = rc > 0;
+  if (rc == 0) {  // broker DENY; rc<0 (broker gone) fails open
+    if (!g_mem_soft) {
+      std::fprintf(stderr,
+                   "tpushim: tpushare: HBM cap exceeded: %lld-byte %s "
+                   "denied (pod over its gpu_mem cap)\n", bytes, what);
+      return false;
+    }
+    std::fprintf(stderr,
+                 "tpushim: HBM cap exceeded by %lld-byte %s (soft mode; "
+                 "not denied)\n", bytes, what);
+  }
+  return true;
+}
+
+PJRT_Error* (*g_real_create_async_buffers)(
+    PJRT_Client_CreateBuffersForAsyncHostToDevice_Args*) = nullptr;
+PJRT_Error* (*g_real_tm_retrieve)(
+    PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer_Args*) = nullptr;
+PJRT_Error* (*g_real_tm_destroy)(
+    PJRT_AsyncHostToDeviceTransferManager_Destroy_Args*) = nullptr;
+PJRT_Error* (*g_real_dma_map)(PJRT_Client_DmaMap_Args*) = nullptr;
+PJRT_Error* (*g_real_dma_unmap)(PJRT_Client_DmaUnmap_Args*) = nullptr;
+
+PJRT_Error* HookedCreateBuffersForAsyncH2D(
+    PJRT_Client_CreateBuffersForAsyncHostToDevice_Args* args) {
+  if (!g_gated || args->shape_specs == nullptr) {
+    return g_real_create_async_buffers(args);
+  }
+  std::vector<long long> sizes;
+  long long total = 0;
+  for (size_t i = 0; i < args->num_shape_specs; i++) {
+    const PJRT_ShapeSpec& spec = args->shape_specs[i];
+    long long elements = 1;
+    for (size_t d = 0; d < spec.num_dims; d++) elements *= spec.dims[d];
+    long long bytes = elements * ElementBytes(spec.element_type);
+    sizes.push_back(bytes);
+    total += bytes;
+  }
+  bool charged = false;
+  if (!ChargeUploadBytes(total, "async host-to-device allocation",
+                         &charged)) {
+    return MakeShimError(
+        PJRT_Error_Code_RESOURCE_EXHAUSTED,
+        "tpushare: HBM cap exceeded: async host-to-device allocation "
+        "denied (pod over its gpu_mem cap)");
+  }
+  PJRT_Error* err = g_real_create_async_buffers(args);
+  if (err == nullptr && args->transfer_manager != nullptr && charged) {
+    std::lock_guard<std::mutex> lock(g_mem_mu);
+    TransferManagerCharge& tm = TransferManagers()[args->transfer_manager];
+    tm.per_buffer = std::move(sizes);
+    tm.remaining = total;
+  } else if (err != nullptr && charged) {
+    tpushare_mem_request(-total);  // create failed: roll the charge back
+  }
+  return err;
+}
+
+PJRT_Error* HookedAsyncH2DRetrieveBuffer(
+    PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer_Args* args) {
+  PJRT_Error* err = g_real_tm_retrieve(args);
+  if (g_gated && err == nullptr && args->buffer_out != nullptr) {
+    // hand the buffer's share of the create-time charge to the regular
+    // ledger: from here on Buffer_Destroy credits it like any upload
+    std::lock_guard<std::mutex> lock(g_mem_mu);
+    auto it = TransferManagers().find(args->transfer_manager);
+    if (it != TransferManagers().end()) {
+      TransferManagerCharge& tm = it->second;
+      int idx = args->buffer_index;
+      if (idx >= 0 && static_cast<size_t>(idx) < tm.per_buffer.size() &&
+          tm.per_buffer[idx] > 0) {
+        ChargedBuffers()[args->buffer_out] += tm.per_buffer[idx];
+        tm.remaining -= tm.per_buffer[idx];
+        tm.per_buffer[idx] = -1;  // first retrieve transfers ownership
+      }
+    }
+  }
+  return err;
+}
+
+PJRT_Error* HookedAsyncH2DDestroy(
+    PJRT_AsyncHostToDeviceTransferManager_Destroy_Args* args) {
+  if (g_gated && args->transfer_manager != nullptr) {
+    long long credit = 0;
+    {
+      std::lock_guard<std::mutex> lock(g_mem_mu);
+      auto it = TransferManagers().find(args->transfer_manager);
+      if (it != TransferManagers().end()) {
+        credit = it->second.remaining;
+        TransferManagers().erase(it);
+      }
+    }
+    // unretrieved buffers die with the manager; retrieved ones live on
+    // and are credited by their own Buffer_Destroy
+    if (credit > 0) tpushare_mem_request(-credit);
+  }
+  return g_real_tm_destroy(args);
+}
+
+PJRT_Error* HookedDmaMap(PJRT_Client_DmaMap_Args* args) {
+  if (!g_gated) return g_real_dma_map(args);
+  long long bytes = static_cast<long long>(args->size);
+  bool charged = false;
+  if (!ChargeUploadBytes(bytes, "dma mapping", &charged)) {
+    return MakeShimError(
+        PJRT_Error_Code_RESOURCE_EXHAUSTED,
+        "tpushare: HBM cap exceeded: dma mapping denied (pod over its "
+        "gpu_mem cap)");
+  }
+  PJRT_Error* err = g_real_dma_map(args);
+  if (err == nullptr && charged && args->data != nullptr) {
+    std::lock_guard<std::mutex> lock(g_mem_mu);
+    DmaMapped()[args->data] += bytes;
+  } else if (err != nullptr && charged) {
+    tpushare_mem_request(-bytes);
+  }
+  return err;
+}
+
+PJRT_Error* HookedDmaUnmap(PJRT_Client_DmaUnmap_Args* args) {
+  PJRT_Error* err = g_real_dma_unmap(args);
+  if (g_gated && err == nullptr && args->data != nullptr) {
+    long long credit = 0;
+    {
+      std::lock_guard<std::mutex> lock(g_mem_mu);
+      auto it = DmaMapped().find(args->data);
+      if (it != DmaMapped().end()) {
+        credit = it->second;
+        DmaMapped().erase(it);
+      }
+    }
+    if (credit > 0) tpushare_mem_request(-credit);
+  }
+  return err;
+}
+
+// -----------------------------------------------------------------------
 // Executable output accounting: outputs allocate HBM without passing any
 // host->device hook, so Execute charges them on first sighting.  The
 // per-LoadedExecutable output count comes from GetExecutable →
@@ -709,6 +893,11 @@ PJRT_Error* HookedClientDestroy(PJRT_Client_Destroy_Args* args) {
       OverflowBuffers().clear();
       g_overflow_bytes = 0;
       NumOutputsCache().clear();
+      // transfer managers and dma mappings die with their client too
+      for (const auto& kv : TransferManagers()) credit += kv.second.remaining;
+      TransferManagers().clear();
+      for (const auto& kv : DmaMapped()) credit += kv.second;
+      DmaMapped().clear();
     }
     if (credit > 0) tpushare_mem_request(-credit);
   }
@@ -771,6 +960,32 @@ const PJRT_Api* WrapApi(const PJRT_Api* real) {
   }
   if (g_real_loaded_destroy != nullptr) {
     wrapped.PJRT_LoadedExecutable_Destroy = HookedLoadedExecutableDestroy;
+  }
+  // async host-to-device + dma-map alloc paths (VERDICT r4 #2)
+  g_real_create_async_buffers =
+      wrapped.PJRT_Client_CreateBuffersForAsyncHostToDevice;
+  g_real_tm_retrieve =
+      wrapped.PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer;
+  g_real_tm_destroy = wrapped.PJRT_AsyncHostToDeviceTransferManager_Destroy;
+  g_real_dma_map = wrapped.PJRT_Client_DmaMap;
+  g_real_dma_unmap = wrapped.PJRT_Client_DmaUnmap;
+  if (g_real_create_async_buffers != nullptr) {
+    wrapped.PJRT_Client_CreateBuffersForAsyncHostToDevice =
+        HookedCreateBuffersForAsyncH2D;
+  }
+  if (g_real_tm_retrieve != nullptr) {
+    wrapped.PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer =
+        HookedAsyncH2DRetrieveBuffer;
+  }
+  if (g_real_tm_destroy != nullptr) {
+    wrapped.PJRT_AsyncHostToDeviceTransferManager_Destroy =
+        HookedAsyncH2DDestroy;
+  }
+  if (g_real_dma_map != nullptr) {
+    wrapped.PJRT_Client_DmaMap = HookedDmaMap;
+  }
+  if (g_real_dma_unmap != nullptr) {
+    wrapped.PJRT_Client_DmaUnmap = HookedDmaUnmap;
   }
   // fabricated-error service entries (pass-through for real errors)
   wrapped.PJRT_Error_Destroy = HookedErrorDestroy;

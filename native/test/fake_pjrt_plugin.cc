@@ -42,6 +42,11 @@ std::atomic<int> g_events_created{0};
 std::atomic<int> g_events_fired{0};
 std::atomic<int> g_events_destroyed{0};
 std::atomic<uintptr_t> g_next_handle{0x1000};
+std::atomic<int> g_tm_creates{0};
+std::atomic<int> g_tm_retrieves{0};
+std::atomic<int> g_tm_destroys{0};
+std::atomic<int> g_dma_maps{0};
+std::atomic<int> g_dma_unmaps{0};
 
 int DeviceMs() {
   static int ms = [] {
@@ -319,6 +324,41 @@ PJRT_Error* FakeOnDeviceSize(PJRT_Buffer_OnDeviceSizeInBytes_Args* args) {
   return nullptr;
 }
 
+// async host-to-device transfer-manager surface: handles only, no real
+// allocation — the interposer's accounting is what is under test
+PJRT_Error* FakeCreateAsyncBuffers(
+    PJRT_Client_CreateBuffersForAsyncHostToDevice_Args* args) {
+  g_tm_creates++;
+  args->transfer_manager =
+      reinterpret_cast<PJRT_AsyncHostToDeviceTransferManager*>(
+          g_next_handle.fetch_add(16));
+  return nullptr;
+}
+
+PJRT_Error* FakeTMRetrieve(
+    PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer_Args* args) {
+  g_tm_retrieves++;
+  args->buffer_out =
+      reinterpret_cast<PJRT_Buffer*>(g_next_handle.fetch_add(16));
+  return nullptr;
+}
+
+PJRT_Error* FakeTMDestroy(
+    PJRT_AsyncHostToDeviceTransferManager_Destroy_Args*) {
+  g_tm_destroys++;
+  return nullptr;
+}
+
+PJRT_Error* FakeDmaMap(PJRT_Client_DmaMap_Args*) {
+  g_dma_maps++;
+  return nullptr;
+}
+
+PJRT_Error* FakeDmaUnmap(PJRT_Client_DmaUnmap_Args*) {
+  g_dma_unmaps++;
+  return nullptr;
+}
+
 }  // namespace
 
 extern "C" {
@@ -331,6 +371,11 @@ int fake_destroy_calls(void) { return g_destroy_calls.load(); }
 int fake_events_created(void) { return g_events_created.load(); }
 int fake_events_fired(void) { return g_events_fired.load(); }
 int fake_events_destroyed(void) { return g_events_destroyed.load(); }
+int fake_tm_creates(void) { return g_tm_creates.load(); }
+int fake_tm_retrieves(void) { return g_tm_retrieves.load(); }
+int fake_tm_destroys(void) { return g_tm_destroys.load(); }
+int fake_dma_maps(void) { return g_dma_maps.load(); }
+int fake_dma_unmaps(void) { return g_dma_unmaps.load(); }
 
 const char* fake_client_create_options(void) {
   static std::string copy;
@@ -363,6 +408,11 @@ const PJRT_Api* GetPjrtApi(void) {
     api.PJRT_LoadedExecutable_GetExecutable = FakeGetExecutable;
     api.PJRT_Executable_NumOutputs = FakeNumOutputs;
     api.PJRT_Executable_Destroy = FakeExecutableDestroy;
+    api.PJRT_Client_CreateBuffersForAsyncHostToDevice = FakeCreateAsyncBuffers;
+    api.PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer = FakeTMRetrieve;
+    api.PJRT_AsyncHostToDeviceTransferManager_Destroy = FakeTMDestroy;
+    api.PJRT_Client_DmaMap = FakeDmaMap;
+    api.PJRT_Client_DmaUnmap = FakeDmaUnmap;
     initialized = true;
   }
   return &api;

@@ -75,6 +75,9 @@ int main(int argc, char** argv) {
     return 2;
   }
   long long upload_bytes = 4096;
+  long long async_bytes = 0;
+  long long dma_bytes = 0;
+  bool async_no_retrieve = false;
   bool keep_buffer = false;
   bool caller_events = false;
   bool destroy_outputs = false;
@@ -86,6 +89,12 @@ int main(int argc, char** argv) {
     std::string flag = argv[i];
     if (flag == "--upload-bytes" && i + 1 < argc) {
       upload_bytes = std::atoll(argv[++i]);
+    } else if (flag == "--async-upload" && i + 1 < argc) {
+      async_bytes = std::atoll(argv[++i]);
+    } else if (flag == "--async-no-retrieve") {
+      async_no_retrieve = true;
+    } else if (flag == "--dma-map" && i + 1 < argc) {
+      dma_bytes = std::atoll(argv[++i]);
     } else if (flag == "--keep-buffer") {
       keep_buffer = true;
     } else if (flag == "--events") {
@@ -203,6 +212,101 @@ int main(int argc, char** argv) {
       api->PJRT_Buffer_Destroy(&destroy_args);
     }
     std::printf("outputs_destroyed %zu\n", collected_outputs.size());
+  }
+
+  // async host-to-device cycle (--async-upload B): create a one-shape
+  // transfer manager, retrieve its buffer (unless --async-no-retrieve),
+  // destroy manager then buffer — the full alloc path the interposer must
+  // meter (VERDICT r4 #2).  Runs BEFORE the plain upload so a test can
+  // prove the credit: cycle at cap, then upload at cap succeeds only if
+  // the destroys credited the broker.
+  if (async_bytes > 0 &&
+      api->PJRT_Client_CreateBuffersForAsyncHostToDevice != nullptr) {
+    PJRT_ShapeSpec spec;
+    std::memset(&spec, 0, sizeof(spec));
+    spec.struct_size = PJRT_ShapeSpec_STRUCT_SIZE;
+    int64_t adims[1] = {async_bytes / 4};
+    spec.dims = adims;
+    spec.num_dims = 1;
+    spec.element_type = PJRT_Buffer_Type_F32;
+    PJRT_Client_CreateBuffersForAsyncHostToDevice_Args cargs;
+    std::memset(&cargs, 0, sizeof(cargs));
+    cargs.struct_size =
+        PJRT_Client_CreateBuffersForAsyncHostToDevice_Args_STRUCT_SIZE;
+    cargs.shape_specs = &spec;
+    cargs.num_shape_specs = 1;
+    PJRT_Error* err =
+        api->PJRT_Client_CreateBuffersForAsyncHostToDevice(&cargs);
+    if (err != nullptr) {
+      std::printf("async_create_denied code=%d msg=%s\n",
+                  static_cast<int>(ErrorCode(api, err)),
+                  ErrorMessage(api, err).c_str());
+      DestroyError(api, err);
+    } else {
+      std::printf("async_create_ok\n");
+      PJRT_Buffer* abuf = nullptr;
+      if (!async_no_retrieve &&
+          api->PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer !=
+              nullptr) {
+        PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer_Args rargs;
+        std::memset(&rargs, 0, sizeof(rargs));
+        rargs.struct_size =
+            PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer_Args_STRUCT_SIZE;
+        rargs.transfer_manager = cargs.transfer_manager;
+        rargs.buffer_index = 0;
+        if (api->PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer(
+                &rargs) == nullptr) {
+          abuf = rargs.buffer_out;
+          std::printf("async_retrieve_ok\n");
+        }
+      }
+      if (api->PJRT_AsyncHostToDeviceTransferManager_Destroy != nullptr) {
+        PJRT_AsyncHostToDeviceTransferManager_Destroy_Args dargs;
+        std::memset(&dargs, 0, sizeof(dargs));
+        dargs.struct_size =
+            PJRT_AsyncHostToDeviceTransferManager_Destroy_Args_STRUCT_SIZE;
+        dargs.transfer_manager = cargs.transfer_manager;
+        DestroyError(api,
+                     api->PJRT_AsyncHostToDeviceTransferManager_Destroy(
+                         &dargs));
+        std::printf("tm_destroyed\n");
+      }
+      if (abuf != nullptr && api->PJRT_Buffer_Destroy != nullptr) {
+        PJRT_Buffer_Destroy_Args bdargs;
+        std::memset(&bdargs, 0, sizeof(bdargs));
+        bdargs.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+        bdargs.buffer = abuf;
+        DestroyError(api, api->PJRT_Buffer_Destroy(&bdargs));
+        std::printf("async_buffer_destroyed\n");
+      }
+    }
+  }
+
+  // dma-map cycle (--dma-map B): map a host region device-visible, then
+  // unmap — charged/credited like an upload
+  if (dma_bytes > 0 && api->PJRT_Client_DmaMap != nullptr) {
+    static char dma_region[16];  // identity only; the fake never reads it
+    PJRT_Client_DmaMap_Args margs;
+    std::memset(&margs, 0, sizeof(margs));
+    margs.struct_size = PJRT_Client_DmaMap_Args_STRUCT_SIZE;
+    margs.data = dma_region;
+    margs.size = static_cast<size_t>(dma_bytes);
+    PJRT_Error* err = api->PJRT_Client_DmaMap(&margs);
+    if (err != nullptr) {
+      std::printf("dma_map_denied code=%d\n",
+                  static_cast<int>(ErrorCode(api, err)));
+      DestroyError(api, err);
+    } else {
+      std::printf("dma_map_ok\n");
+      if (api->PJRT_Client_DmaUnmap != nullptr) {
+        PJRT_Client_DmaUnmap_Args uargs;
+        std::memset(&uargs, 0, sizeof(uargs));
+        uargs.struct_size = PJRT_Client_DmaUnmap_Args_STRUCT_SIZE;
+        uargs.data = dma_region;
+        DestroyError(api, api->PJRT_Client_DmaUnmap(&uargs));
+        std::printf("dma_unmapped\n");
+      }
+    }
   }
 
   // one host->device upload of upload_bytes (f32), destroyed again unless

@@ -22,6 +22,7 @@
 //
 // Wire protocol (line-based TCP; pmgr proxies and stamps pod identity):
 //   REQ <pod> <est_ms>   -> TOK <quota_ms> | WAIT <retry_ms>
+//   REQB <pod> <est_ms> <timeout_ms> -> TOK <quota_ms> | WAIT <retry_ms>
 //   RET <pod> <used_ms>  -> OK
 //   MEM <pod> <delta>    -> OK <used> <cap> | DENY <used> <cap>
 //   STAT                 -> one JSON line
@@ -34,6 +35,14 @@
 // the REQ literally waits for the RET queued behind it).  Client-side
 // polling keeps one connection per client, so the per-connection grant
 // ledger (Abandon on disconnect) pairs every REQ with its RET exactly.
+//
+// REQB is the LONG-POLL variant for clients whose RET shares the request
+// thread (the Python TokenClient: synchronous step loop, no callback
+// RETs): the server parks the connection thread until the grant succeeds
+// or timeout_ms elapses, so handoff is event-driven — a released token
+// wakes the next waiter immediately instead of at its next poll tick.
+// Not composed with the -G gang gate (peer consultation is poll-shaped);
+// under -G a REQB behaves exactly like REQ.
 //
 // Scheduling policy, two modes:
 //
@@ -63,6 +72,7 @@
 #include <atomic>
 #include <chrono>
 #include <cmath>
+#include <condition_variable>
 #include <cstring>
 #include <deque>
 #include <fstream>
@@ -159,6 +169,7 @@ class TokenScheduler {
         ++it;
       }
     }
+    cv_.notify_all();  // limits may have loosened for parked waiters
   }
 
   // One non-blocking grant attempt.  Returns {granted, quota_ms} on
@@ -176,7 +187,7 @@ class TokenScheduler {
     }
     if (!ok) {
       q.last_wait_poll = now;  // stays a live waiter for ~kWaiterStaleMs
-      return {false, RetryHintLocked(q)};
+      return {false, RetryHintLocked(q, now)};
     }
     q.last_wait_poll = 0.0;
     q.grants++;
@@ -186,8 +197,51 @@ class TokenScheduler {
     return {true, quota};
   }
 
+  // Event-driven acquire (REQB): parks the calling connection thread until
+  // the grant succeeds or timeout_ms elapses.  Handoff happens at the
+  // moment of Release (condition-variable notify) instead of at the next
+  // poll tick — on a serial-core host the polling alternative either
+  // burns the holder's cycles (short hints) or idles the chip past the
+  // release (long hints; both measured on the co-run bench).  The parked
+  // pod re-stamps its waiter liveness every wakeup so exclusive-mode
+  // arbitration and quota sizing keep seeing it.
+  std::pair<bool, double> BlockingAcquire(const std::string& pod,
+                                          double est_ms, double timeout_ms) {
+    std::unique_lock<std::mutex> lock(mu_);
+    double deadline = NowMs() + std::max(0.0, timeout_ms);
+    while (true) {
+      DecayAllLocked();
+      double now = NowMs();
+      PodQuota& q = Ensure(pod);
+      bool ok;
+      if (opt_.exclusive) {
+        ok = holders_.empty() && Eligible(pod) && IsChosen(pod, now);
+      } else {
+        ok = Eligible(pod) &&
+             (Starved(pod) || !StarvedWaiterExists(pod, now));
+      }
+      if (ok) {
+        q.last_wait_poll = 0.0;
+        q.grants++;
+        double quota = QuotaFor(q, est_ms, now);
+        holders_[pod]++;
+        q.outstanding_quotas.push_back({quota, now});
+        return {true, quota};
+      }
+      q.last_wait_poll = now;
+      if (now >= deadline) return {false, RetryHintLocked(q, now)};
+      // bounded wait: recheck periodically even without a notify so
+      // decay-driven eligibility (limit-throttled pods) is not missed
+      // and the liveness stamp stays fresh (kWaiterStaleMs)
+      double chunk = std::min(deadline - now, 50.0);
+      cv_.wait_for(lock,
+                   std::chrono::duration<double, std::milli>(chunk));
+    }
+  }
+
   void Release(const std::string& pod, double used_ms) {
     std::lock_guard<std::mutex> lock(mu_);
+    cv_.notify_all();  // a token frees: parked REQB waiters re-arbitrate
     auto it = holders_.find(pod);
     if (it == holders_.end()) return;
     PodQuota& q = Ensure(pod);
@@ -232,6 +286,7 @@ class TokenScheduler {
     }
     it->second -= n;
     if (it->second <= 0) holders_.erase(it);
+    cv_.notify_all();  // abandoned tokens free the chip for parked waiters
   }
 
   // Roll back the NEWEST outstanding grant with zero charge: the token
@@ -247,6 +302,7 @@ class TokenScheduler {
     PodQuota& q = Ensure(pod);
     if (!q.outstanding_quotas.empty()) q.outstanding_quotas.pop_back();
     if (--it->second <= 0) holders_.erase(it);
+    cv_.notify_all();
     return true;
   }
 
@@ -280,7 +336,7 @@ class TokenScheduler {
       ok = Eligible(pod) && (Starved(pod) || !StarvedWaiterExists(pod, now));
     }
     if (ok) return {true, 0.0, true};
-    return {false, RetryHintLocked(it->second), true};
+    return {false, RetryHintLocked(it->second, now), true};
   }
 
   // TryAcquire's eligibility half for the gang-gated REQ path: same
@@ -302,7 +358,7 @@ class TokenScheduler {
     }
     if (!ok) {
       q.last_wait_poll = now;  // stays a live waiter for ~kWaiterStaleMs
-      return {false, RetryHintLocked(q)};
+      return {false, RetryHintLocked(q, now)};
     }
     return {true, 0.0};
   }
@@ -363,7 +419,15 @@ class TokenScheduler {
 
   // Suggested client poll delay: time until decay restores eligibility,
   // clamped to a responsive band.
-  double RetryHintLocked(const PodQuota& q) {
+  double RetryHintLocked(const PodQuota& q, double now) {
+    (void)now;
+    // Note: a "sleep until the holder's expected release" hint was tried
+    // here (remaining quota of the newest grant) and measured WORSE than
+    // plain short polling on the co-run bench: the waiter overshoots the
+    // release by up to its sleep granularity and the chip idles at every
+    // handoff.  Event-driven handoff lives in BlockingAcquire (REQB);
+    // REQ keeps the short hint for clients that must poll (the shim's
+    // connection carries completion-callback RETs and cannot block).
     double share = q.used_ms / opt_.window;
     double hint = 5.0;
     if (share >= q.limit && share > 0.0) {
@@ -461,6 +525,7 @@ class TokenScheduler {
 
   const Options& opt_;
   std::mutex mu_;
+  std::condition_variable cv_;  // signaled whenever a token frees
   std::map<std::string, PodQuota> pods_;
   std::map<std::string, int> holders_;  // pod -> outstanding token count
 };
@@ -672,6 +737,37 @@ void ServeClient(int fd, TokenScheduler* sched, PeerGate* gate) {
         } else {
           if (!WriteAll(fd, "WAIT " + std::to_string(value) + "\n")) break;
         }
+      }
+    } else if (cmd == "REQB") {
+      double est = 0, timeout_ms = 0;
+      in >> pod >> est >> timeout_ms;
+      if (pod.empty()) break;
+      bool granted;
+      double value;
+      if (gate != nullptr) {
+        // gang gate: peer consultation is poll-shaped; degrade to REQ
+        auto [local_ok, local_hint] = sched->PreflightAcquire(pod);
+        if (!local_ok) {
+          granted = false;
+          value = local_hint;
+        } else {
+          auto [peers_ok, peer_hint] = gate->AllEligible(pod);
+          if (!peers_ok) {
+            granted = false;
+            value = std::max(5.0, std::min(100.0, peer_hint));
+          } else {
+            std::tie(granted, value) = sched->TryAcquire(pod, est);
+          }
+        }
+      } else {
+        std::tie(granted, value) =
+            sched->BlockingAcquire(pod, est, timeout_ms);
+      }
+      if (granted) {
+        outstanding[pod]++;
+        if (!WriteAll(fd, "TOK " + std::to_string(value) + "\n")) break;
+      } else {
+        if (!WriteAll(fd, "WAIT " + std::to_string(value) + "\n")) break;
       }
     } else if (cmd == "ELIG") {
       in >> pod;

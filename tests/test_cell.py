@@ -301,6 +301,15 @@ class TestChipBox:
         assert chip_box([None, (1, 0, 0)], 2) == "2,1,1"
         assert chip_box([], 0) == "1,1,1"
 
+    def test_4d_coords_fall_back_linear(self):
+        from kubeshare_tpu.cell.topology import chip_box
+
+        # a 4-D box tiling exactly (2x1x1x2 = 4 chips) cannot be
+        # expressed in the 3-field bounds syntax; truncating its dims
+        # would claim volume 2 != 4 (ADVICE r4)
+        coords = [(0, 0, 0, 0), (1, 0, 0, 0), (0, 0, 0, 1), (1, 0, 0, 1)]
+        assert chip_box(coords, 4) == "4,1,1"
+
     def test_duplicate_coords_fall_back_linear(self):
         from kubeshare_tpu.cell.topology import chip_box
 

@@ -1128,6 +1128,47 @@ class TestDecoding:
         # the split path keeps the one-shot path's loud overflow failure
         with pytest.raises(ValueError, match="capacity"):
             greedy_decode_with_cache(params, config, cache, logits, 32)
+        # zero/negative generation lengths fail loudly too (ADVICE r4)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            greedy_decode_with_cache(params, config, cache, logits, 0)
+
+    def test_jitted_continuation_overflow_caught_with_static_prefill(self):
+        """ADVICE r4 (medium): under jit the cache length is traced, so
+        the capacity bound can only bind through the static
+        ``prefill_length`` — a jitted continuation from a nearly-full
+        cache must fail at trace time, not clamp-overwrite the last
+        slot."""
+        from kubeshare_tpu.models.decoding import (
+            greedy_decode_with_cache, prefill)
+        from kubeshare_tpu.models.transformer import (
+            TransformerConfig, transformer_init)
+
+        config = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq_len=16, dtype=jnp.float32, attention="reference",
+            positional="rope")
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 12), 0, 64)
+        cache, logits = prefill(params, config, prompt)
+
+        # 12 prefilled + 8 > 16: the jitted serving pattern
+        # (examples/serve_fractional.py) with the static prefill length
+        decode_fn = jax.jit(
+            lambda c, lg: greedy_decode_with_cache(
+                params, config, c, lg, 8, prefill_length=12))
+        with pytest.raises(ValueError, match="capacity"):
+            decode_fn(cache, logits)
+        # with headroom the same jit runs
+        ok_fn = jax.jit(
+            lambda c, lg: greedy_decode_with_cache(
+                params, config, c, lg, 4, prefill_length=12))
+        out = ok_fn(cache, logits)
+        assert out.shape == (1, 4)
+        # outside jit the cache's CONCRETE length stays authoritative: an
+        # understated prefill_length must not bypass the real bound
+        with pytest.raises(ValueError, match="capacity"):
+            greedy_decode_with_cache(params, config, cache, logits, 8,
+                                     prefill_length=4)
 
     def test_sampled_decode_from_cache_matches_one_shot(self):
         """sample_decode == prefill + sample_decode_with_cache under the
