@@ -218,8 +218,10 @@ def worker_main(args: argparse.Namespace) -> None:
         # phases self-calibrate (the chip is theirs alone, so the
         # measurement is clean); the orchestrator feeds the solo mean to
         # the co-run workers, whose own measurement would be inflated by
-        # contention.
-        n = 5
+        # contention.  n=10: the calibration mean sets each pod's duty
+        # point, so its sampling noise lands directly in the ratio —
+        # at n=5 it was the largest run-to-run variance term.
+        n = 10
         start = time.monotonic()
         for _ in range(n):
             state, loss = train_step(state, 0, 0)
@@ -349,8 +351,8 @@ def worker_decode_main(args: argparse.Namespace) -> None:
     if args.calibrate_io:
         # serving at 0.5 duty: requests arrive with gaps ~ the service
         # time, measured ungated on this chip (same convention as the
-        # train workload's input-pipeline calibration)
-        n = 5
+        # train workload's input-pipeline calibration, incl. n=10)
+        n = 10
         start = time.monotonic()
         for i in range(n):
             jax.block_until_ready(decode_chunk(prompts[i % 16]))

@@ -125,7 +125,13 @@ class ExecutionGuard:
         with self._lock:
             self._last_activity = time.monotonic()
             self._in_flight = True  # a step follows; idle monitor backs off
-            if self._held and self._budget_ms > 0:
+            # reuse the held token only when its remaining budget covers
+            # the coming burst: running a full step on a sliver of
+            # leftover budget overdraws the grant AND skips the broker's
+            # re-arbitration — under exclusive co-tenancy that steals a
+            # whole extra turn from a parked peer (measured ~25% of the
+            # co-run bench's aggregate before this check)
+            if self._held and self._budget_ms >= 0.5 * self._estimate_ms:
                 return self._budget_ms
             if self._held:
                 self._release_held()
@@ -148,7 +154,14 @@ class ExecutionGuard:
             self.total_gated_ms += elapsed_ms
             self._budget_ms -= elapsed_ms
             self._held_used_ms += elapsed_ms
-            if self._held and self._budget_ms <= 0:
+            # release at the step boundary once the budget cannot fund
+            # another burst — holding a near-empty token through the
+            # caller's input-pipeline wait idles the chip for exactly the
+            # wait (the waiter is parked broker-side; work conservation
+            # demands the handoff happen HERE, not at the idle monitor's
+            # 200 ms horizon).  A budget still >= a step keeps amortizing
+            # grants (many small steps per token, the Gemini quantum).
+            if self._held and self._budget_ms < 0.5 * self._estimate_ms:
                 self._release_held()
 
     # backwards-compatible single-step release

@@ -337,6 +337,35 @@ def greedy_decode(
                                     max_new_tokens)
 
 
+def _check_speculative_args(
+    config: TransformerConfig,
+    draft_config: TransformerConfig,
+    prompt_len: int,
+    max_new_tokens: int,
+    draft_len: int,
+) -> None:
+    """Shared validation for both speculative decoders: generation
+    length, draft width, vocabulary match, and draft_len slots of cache
+    headroom in BOTH models."""
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if draft_len < 2:
+        raise ValueError(f"draft_len must be >= 2, got {draft_len}")
+    if config.vocab_size != draft_config.vocab_size:
+        raise ValueError(
+            f"target and draft vocabularies differ "
+            f"({config.vocab_size} vs {draft_config.vocab_size})"
+        )
+    total = prompt_len + max_new_tokens + draft_len
+    for name, c in (("target", config), ("draft", draft_config)):
+        if total > c.max_seq_len:
+            raise ValueError(
+                f"prompt + max_new_tokens + draft_len = {total} exceeds "
+                f"the {name} max_seq_len {c.max_seq_len} (speculation "
+                f"needs draft_len slots of cache headroom)"
+            )
+
+
 def speculative_greedy_decode(
     params,
     config: TransformerConfig,
@@ -369,23 +398,8 @@ def speculative_greedy_decode(
     the next round.  Both models must share a vocabulary; the caches
     need headroom of ``draft_len`` beyond the generated text."""
     batch, prompt_len = prompt.shape
-    if max_new_tokens < 1:
-        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
-    if draft_len < 2:
-        raise ValueError(f"draft_len must be >= 2, got {draft_len}")
-    if config.vocab_size != draft_config.vocab_size:
-        raise ValueError(
-            f"target and draft vocabularies differ "
-            f"({config.vocab_size} vs {draft_config.vocab_size})"
-        )
-    total = prompt_len + max_new_tokens + draft_len
-    for name, c in (("target", config), ("draft", draft_config)):
-        if total > c.max_seq_len:
-            raise ValueError(
-                f"prompt + max_new_tokens + draft_len = {total} exceeds "
-                f"the {name} max_seq_len {c.max_seq_len} (speculation "
-                f"needs draft_len slots of cache headroom)"
-            )
+    _check_speculative_args(config, draft_config, prompt_len,
+                            max_new_tokens, draft_len)
 
     cache, logits = prefill(params, config, prompt)
     dcache, _ = prefill(draft_params, draft_config, prompt)
@@ -450,6 +464,169 @@ def speculative_greedy_decode(
     return out[:, :max_new_tokens]
 
 
+def speculative_sample_decode(
+    params,
+    config: TransformerConfig,
+    draft_params,
+    draft_config: TransformerConfig,
+    prompt: jax.Array,
+    rng: jax.Array,
+    max_new_tokens: int,
+    draft_len: int = 4,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    return_stats: bool = False,
+) -> jax.Array:
+    """Sampled generation with draft-model speculation: the emitted
+    stream has EXACTLY the target model's sampling distribution (the
+    standard speculative-sampling rejection rule — accept draft token x
+    with probability min(1, p(x)/q(x)); on rejection, resample from the
+    residual norm(max(p - q, 0)); a fully-accepted round earns a bonus
+    token from the target's next-position distribution).  ``p`` and
+    ``q`` are the temperature/top-k/top-p-FILTERED distributions of the
+    target and draft, so the output matches :func:`sample_decode` with
+    the same filters (VERDICT r4 #5).
+
+    Round structure (cache rewind, batch-min acceptance, optimistic K/V)
+    is shared with :func:`speculative_greedy_decode`; rows that accepted
+    beyond the batch-min simply re-draft those tokens next round, which
+    leaves the emitted distribution untouched (unemitted acceptances are
+    discarded, never revealed).  ``temperature=0`` delegates to the
+    greedy variant.  With ``return_stats`` the result is
+    ``(tokens, {"rounds": r})`` — r counts target verify passes, the
+    speculation speedup's denominator."""
+    batch, prompt_len = prompt.shape
+    _check_speculative_args(config, draft_config, prompt_len,
+                            max_new_tokens, draft_len)
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    _filter_logits(jnp.zeros((1, 2)), top_k, top_p)
+    if temperature == 0.0:
+        # greedy delegation has no stats channel: its round count lives
+        # in speculative_greedy_decode's own structure, and inventing a
+        # sentinel here would silently corrupt speedup arithmetic
+        if return_stats:
+            raise ValueError(
+                "return_stats is unavailable at temperature=0 (the call "
+                "delegates to speculative_greedy_decode)")
+        return speculative_greedy_decode(
+            params, config, draft_params, draft_config, prompt,
+            max_new_tokens, draft_len)
+
+    def log_dist(logits):
+        # filtered + temperature-scaled log-distribution over the last
+        # axis; _filter_logits is [rows, vocab]-shaped, so fold any
+        # leading dims (the verify chunk is [b, k, vocab])
+        flat = logits.reshape(-1, logits.shape[-1])
+        out = jax.nn.log_softmax(
+            _filter_logits(flat / temperature, top_k, top_p), axis=-1)
+        return out.reshape(logits.shape)
+
+    cache, logits = prefill(params, config, prompt)
+    dcache, _ = prefill(draft_params, draft_config, prompt)
+    rng, first_key = jax.random.split(rng)
+    first = jax.random.categorical(
+        first_key, log_dist(logits), axis=-1).astype(jnp.int32)
+    out = jnp.zeros((batch, max_new_tokens + draft_len), jnp.int32)
+    out = out.at[:, 0].set(first)
+
+    def cond(state):
+        return state[3] < max_new_tokens
+
+    def body(state):
+        cache, dcache, out, n_done, last, rng, rounds = state
+        rng, draft_rng, accept_key, fix_key = jax.random.split(rng, 4)
+
+        # 1. draft proposes draft_len-1 SAMPLED tokens after `last`,
+        # keeping each position's full filtered log-distribution q (the
+        # acceptance test and the residual both need it).  The final
+        # step feeds p_{k-1} so the draft cache covers a full accept.
+        def draft_step(carry, key):
+            dc, tok = carry
+            lg, dc = _decode_one(draft_params, draft_config, dc, tok)
+            logq = log_dist(lg)
+            nxt = jax.random.categorical(key, logq, axis=-1).astype(jnp.int32)
+            return (dc, nxt), (nxt, logq)
+
+        (dcache, _), (proposal_all, logq_all) = jax.lax.scan(
+            draft_step, (dcache, last),
+            jax.random.split(draft_rng, draft_len))
+        proposal = proposal_all.T[:, :draft_len - 1]   # [b, k-1]
+        logq = logq_all[:draft_len - 1]                # [k-1, b, vocab]
+
+        # 2. target verifies the round in one chunk: filtered log-p at
+        # every position ([b, k, vocab] -> [k, b, vocab] to align with q)
+        chunk = jnp.concatenate([last[:, None], proposal], axis=1)
+        target_length = cache["length"]
+        chunk_logits, cache = _decode_chunk(params, config, cache, chunk)
+        logp = jnp.moveaxis(log_dist(chunk_logits), 1, 0)  # [k, b, vocab]
+
+        # 3. rejection rule per proposal position: accept x_i w.p.
+        # min(1, p(x_i)/q(x_i)); leading-accept count, batch-min shared
+        # (one cache length for all rows)
+        def gather(dist, tok):  # [k-1, b, vocab], [b, k-1] -> [k-1, b]
+            return jnp.take_along_axis(
+                dist, tok.T[..., None], axis=-1)[..., 0]
+
+        ratio = gather(logp[:draft_len - 1], proposal) - gather(logq, proposal)
+        u = jax.random.uniform(accept_key, ratio.shape)
+        accepted = jnp.log(u) < jnp.minimum(ratio, 0.0)     # [k-1, b]
+        matches = jnp.cumprod(accepted.T.astype(jnp.int32), axis=1)
+        m = jnp.min(jnp.sum(matches, axis=1))  # 0..draft_len-1
+
+        # 4. the token at emitted position m+1, per row:
+        #    - its row rejected x_{m+1} (accept count == m < k-1):
+        #      residual sample from norm(max(p_{m+1} - q_{m+1}, 0))
+        #    - its row accepted past m (count > m): x_{m+1} itself
+        #    - m == k-1 (every row accepted everything): bonus from
+        #      p_k — logp[draft_len-1], where no q exists
+        # rows are independent here; only the SHARED length forced m.
+        bonus = m == draft_len - 1
+        pos = jnp.minimum(m, draft_len - 2)
+        p_m = jnp.take(logp, jnp.where(bonus, draft_len - 1, pos), axis=0)
+        q_m = jnp.take(logq, pos, axis=0)
+        residual = jnp.clip(jnp.exp(p_m) - jnp.exp(q_m), 0.0, None)
+        # numerically-empty residual (p == q exactly): any mass works —
+        # acceptance almost surely fired first; fall back to p
+        empty = jnp.sum(residual, axis=-1, keepdims=True) <= 1e-9
+        fix_dist = jnp.where(
+            bonus, p_m,
+            jnp.where(empty, p_m, jnp.log(
+                jnp.where(residual > 0, residual, 1e-38))))
+        fix = jax.random.categorical(
+            fix_key, fix_dist, axis=-1).astype(jnp.int32)
+        row_accepts = jnp.sum(matches, axis=1)  # [b]
+        next_prop = jnp.where(
+            bonus, fix,
+            jnp.where(row_accepts > m,
+                      jnp.take_along_axis(
+                          proposal, pos[None, None].repeat(batch, 0),
+                          axis=1)[:, 0],
+                      fix))
+
+        # 5. emitted stream: x_1..x_m then next_prop; positions past m
+        # are speculative garbage later rounds overwrite
+        idx = jnp.arange(draft_len)
+        stream = jnp.where(
+            idx[None, :] < m,
+            jnp.pad(proposal, ((0, 0), (0, 1))),
+            jnp.where(idx[None, :] == m, next_prop[:, None], 0),
+        )
+        out = jax.lax.dynamic_update_slice(out, stream, (0, n_done))
+
+        cache = dict(cache, length=target_length + m + 1)
+        dcache = dict(dcache, length=target_length + m + 1)
+        last = stream[:, m]
+        return cache, dcache, out, n_done + m + 1, last, rng, rounds + 1
+
+    _, _, out, _, _, _, rounds = jax.lax.while_loop(
+        cond, body,
+        (cache, dcache, out, jnp.int32(1), first, rng, jnp.int32(0)))
+    tokens = out[:, :max_new_tokens]
+    return (tokens, {"rounds": rounds}) if return_stats else tokens
+
+
 def _filter_logits(
     logits: jax.Array,
     top_k: Optional[int],
@@ -498,7 +675,9 @@ def sample_decode(
     combination (k-restriction first, then nucleus — the conventional
     order).  ``temperature=0`` is exact greedy.  Returns
     [batch, max_new_tokens] token ids; jit-compatible like greedy_decode
-    (one compiled scan, static shapes, PRNG split per step)."""
+    (one compiled scan, static shapes, PRNG split per step).  With a
+    draft model available, :func:`speculative_sample_decode` emits the
+    SAME distribution in fewer target passes."""
     total = prompt.shape[1] + max_new_tokens
     if total > config.max_seq_len:
         raise ValueError(
