@@ -179,9 +179,24 @@ def cmd_scheduler(args: argparse.Namespace) -> int:
         metric_server = MetricServer(plugin.collect_metrics, port=args.metrics_port)
         metric_server.start()
         log.info("scheduler metrics on :%d/metrics", metric_server.port)
+    elector = None
+    if getattr(args, "leader_elect", False):
+        import os as _os
+        import socket as _socket
+
+        from .scheduler.leader import LeaderElector
+
+        identity = args.leader_identity or (
+            f"{_socket.gethostname()}-{_os.getpid()}")
+        elector = LeaderElector(
+            cluster, identity, lease_duration_s=args.lease_duration)
+        log.info("leader election on (identity=%s)", identity)
     log.info("scheduler running (bind_mode=%s)", args.bind_mode)
     stop = _install_stop()
     while not stop:
+        if elector is not None and not elector.is_leader():
+            time.sleep(args.idle_interval)
+            continue
         result = engine.run_once()
         if result is None:
             time.sleep(args.idle_interval)
@@ -297,6 +312,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--idle-interval", type=float, default=0.5)
     p.add_argument("--metrics-port", type=int, default=9006,
                    help="scheduler-state metrics port; -1 disables")
+    p.add_argument("--leader-elect", action="store_true",
+                   help="lease-based leader election: only the holder of "
+                        "the kubeshare-scheduler lease runs scheduling "
+                        "cycles (HA replicas; the reference rode "
+                        "kube-scheduler's elector)")
+    p.add_argument("--leader-identity", default="",
+                   help="lease holder identity (default: hostname-pid)")
+    p.add_argument("--lease-duration", type=float, default=15.0)
     p.set_defaults(fn=cmd_scheduler)
 
     p = sub.add_parser("simulate", help="trace-driven load simulation "

@@ -134,6 +134,18 @@ class ClusterAPI:
     def add_node_handler(self, handler: EventHandler) -> None:
         raise NotImplementedError
 
+    def lease_tryhold(
+        self, name: str, identity: str, duration_s: float, now: float
+    ) -> str:
+        """Try to acquire or renew the named leader-election lease for
+        ``identity``; returns the CURRENT holder after the attempt (the
+        caller leads iff that equals its identity).  A lease is free when
+        unheld or expired; the holder renews by calling again.  Backends
+        without lease support raise NotImplementedError — the elector
+        degrades to single-instance mode (the reference rode
+        kube-scheduler's own leader election, deploy/scheduler.yaml)."""
+        raise NotImplementedError
+
 
 _uid_counter = itertools.count(1)
 

@@ -20,6 +20,7 @@ class FakeCluster(ClusterAPI):
         self._nodes: Dict[str, Node] = {}
         self._pod_handlers: List[EventHandler] = []
         self._node_handlers: List[EventHandler] = []
+        self._leases: Dict[str, tuple] = {}  # name -> (holder, expires_at)
         self._lock = threading.RLock()
 
     # ---- pods --------------------------------------------------------
@@ -124,3 +125,14 @@ class FakeCluster(ClusterAPI):
     def _dispatch(self, handlers: List[EventHandler], event: str, obj: object) -> None:
         for handler in list(handlers):
             handler(event, obj)
+
+    # ---- leader-election leases --------------------------------------
+    def lease_tryhold(
+        self, name: str, identity: str, duration_s: float, now: float
+    ) -> str:
+        with self._lock:
+            holder, expires = self._leases.get(name, ("", 0.0))
+            if not holder or now >= expires or holder == identity:
+                self._leases[name] = (identity, now + duration_s)
+                return identity
+            return holder

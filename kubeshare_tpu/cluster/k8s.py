@@ -305,8 +305,97 @@ class K8sCluster(ClusterAPI):
                         continue
                     time.sleep(2)
                 except Exception:
-                    time.sleep(2)  # reconnect after watch errors
+                    # reconnect after watch errors — but never silently: a
+                    # handler or list call failing EVERY attempt would
+                    # otherwise look like a healthy-but-quiet watch
+                    from ..utils.logger import get_logger
+
+                    get_logger("kubeshare-cluster").warning(
+                        "%s watch error (reconnecting in 2s)", kind,
+                        exc_info=True)
+                    time.sleep(2)
 
         thread = threading.Thread(target=run, daemon=True, name=f"watch-{kind}")
         thread.start()
         self._watch_threads.append(thread)
+
+    # ---- leader-election leases --------------------------------------
+    def lease_tryhold(
+        self, name: str, identity: str, duration_s: float, now: float
+    ) -> str:
+        """Lease-object leader election (coordination.k8s.io/v1) — the
+        kube-scheduler pattern the reference rode for HA
+        (deploy/scheduler.yaml:74-112): read-modify-write with optimistic
+        concurrency, the apiserver's 409 on a stale resourceVersion
+        arbitrating racers.  Wall clock is authoritative here (renewTime
+        lives in the Lease object); ``now`` is for clock-injected
+        backends.  Raises NotImplementedError when the client library has
+        no CoordinationV1Api — the elector then degrades to
+        single-instance mode."""
+        import datetime as _dt
+        import os
+
+        client = self._client_mod
+        if not (hasattr(client, "CoordinationV1Api")
+                and hasattr(client, "V1Lease")
+                and hasattr(client, "V1LeaseSpec")):
+            raise NotImplementedError(
+                "kubernetes client lacks the coordination.k8s.io/v1 "
+                "Lease surface")
+        api = client.CoordinationV1Api()
+        namespace = os.environ.get("POD_NAMESPACE", "kube-system")
+
+        def utcnow():
+            return _dt.datetime.now(_dt.timezone.utc)
+
+        holder = ""
+        for _ in range(3):  # optimistic-concurrency retries
+            try:
+                lease = api.read_namespaced_lease(name, namespace)
+            except client.ApiException as e:
+                if e.status != 404:
+                    raise
+                # real OpenAPI model objects: the official client's
+                # serializer rejects plain namespaces (it reads
+                # openapi_types off the body), same as the bind path's
+                # V1Binding
+                body = client.V1Lease(
+                    metadata=client.V1ObjectMeta(name=name),
+                    spec=client.V1LeaseSpec(
+                        holder_identity=identity,
+                        lease_duration_seconds=int(duration_s),
+                        acquire_time=utcnow(),
+                        renew_time=utcnow(),
+                    ),
+                )
+                try:
+                    api.create_namespaced_lease(namespace, body)
+                    return identity
+                except client.ApiException as ce:
+                    if ce.status == 409:
+                        continue  # lost the create race: re-read
+                    raise
+            spec = lease.spec
+            holder = getattr(spec, "holder_identity", None) or ""
+            renew = getattr(spec, "renew_time", None)
+            duration = (getattr(spec, "lease_duration_seconds", None)
+                        or int(duration_s))
+            expired = True
+            if holder and renew is not None:
+                expired = (utcnow() - renew).total_seconds() >= duration
+            if holder and holder != identity and not expired:
+                return holder
+            if holder != identity:
+                spec.acquire_time = utcnow()
+            spec.holder_identity = identity
+            spec.lease_duration_seconds = int(duration_s)
+            spec.renew_time = utcnow()
+            try:
+                api.replace_namespaced_lease(name, namespace, lease)
+                return identity
+            except client.ApiException as e:
+                if e.status == 409:
+                    continue  # raced a peer's renew: re-read
+                raise
+        return holder
+

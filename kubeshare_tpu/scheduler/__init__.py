@@ -2,6 +2,7 @@ from .podspec import PodStatus, parse_pod_labels, PodLabelError
 from .podgroup import PodGroupInfo, PodGroupRegistry, parse_pod_group_labels
 from .plugin import KubeShareScheduler, SchedulerArgs
 from .framework import SchedulerEngine, CycleStatus
+from .leader import LeaderElector
 
 __all__ = [
     "PodStatus",
@@ -14,4 +15,5 @@ __all__ = [
     "SchedulerArgs",
     "SchedulerEngine",
     "CycleStatus",
+    "LeaderElector",
 ]

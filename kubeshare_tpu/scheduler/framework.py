@@ -135,6 +135,24 @@ class SchedulerEngine:
 
     # ------------------------------------------------------------------
     def schedule_pod(self, pod: Pod) -> CycleStatus:
+        # Re-fetch the authoritative object before the cycle (what
+        # kube-scheduler's cache snapshot gives it): under an
+        # eventually-consistent watch the queued snapshot can be STALE —
+        # a pod already bound (whose bound event lost a race with a
+        # resync replay of its unbound past) would otherwise wedge the
+        # queue head forever and, worse, re-reserve cells it already
+        # holds under a fresh uuid (the stale snapshot carries no
+        # placement annotations).
+        current = self.cluster.get_pod(pod.namespace, pod.name)
+        if current is None:
+            self._pending.pop(pod.key, None)
+            return CycleStatus(pod.key, "stale", "pod no longer exists")
+        if current.is_bound() or current.is_completed():
+            self._pending.pop(pod.key, None)
+            return CycleStatus(pod.key, "bound", "already placed",
+                               current.node_name)
+        pod = current
+
         status = self.plugin.pre_filter(pod)
         if not status.ok:
             return CycleStatus(pod.key, "unschedulable", status.message)

@@ -43,12 +43,16 @@ class FakeStore:
         self.pods = {}  # (ns, name) -> object shaped like V1Pod
         self.nodes = {}  # name -> object shaped like V1Node
         self.bindings = []  # (ns, name, node) from the bind subresource
+        self.leases = {}  # (ns, name) -> object shaped like V1Lease
         self.resource_version = 0
         # fault injection
         self.patch_conflicts_remaining = 0  # first N patches raise 409
         self.patch_calls = 0
-        # watch plumbing
-        self.watch_feed = queue.Queue()  # (TYPE, obj) | Exception | STREAM_END
+        # watch plumbing — per-resource feeds, as real watches are: a
+        # pods watch and a nodes watch each hold their own connection
+        # (one shared queue let the node watch thread steal pod events)
+        self.watch_feed = queue.Queue()  # pods: (TYPE, obj)|Exception|STREAM_END
+        self.node_watch_feed = queue.Queue()
         self.watch_stream_kwargs = []  # kwargs of each stream(...) call
         self.list_calls = 0
 
@@ -182,6 +186,9 @@ class CoreV1Api:
             obj.metadata.annotations.update(meta["annotations"] or {})
         self._store.resource_version += 1
         obj.metadata.resource_version = str(self._store.resource_version)
+        # the real apiserver notifies watchers of every mutation; the
+        # scheduler engine's pending-set maintenance rides these events
+        self._store.emit("MODIFIED", obj)
         return obj
 
     def delete_namespaced_pod(self, name, namespace):
@@ -194,7 +201,56 @@ class CoreV1Api:
         obj = self.read_namespaced_pod(name, namespace)
         node = body.target.name
         obj.spec.node_name = node
+        self._store.resource_version += 1
+        obj.metadata.resource_version = str(self._store.resource_version)
         self._store.bindings.append((namespace, name, node))
+        self._store.emit("MODIFIED", obj)  # as the real apiserver would
+
+
+class CoordinationV1Api:
+    """coordination.k8s.io/v1 Lease surface for leader-election tests:
+    read/create/replace with optimistic concurrency (replace with a stale
+    resourceVersion answers 409, like the real apiserver)."""
+
+    def __init__(self, store: FakeStore) -> None:
+        self._store = store
+
+    @staticmethod
+    def _copy(lease):
+        # the real client deserializes a fresh object per call; aliasing
+        # the stored one would let two instances mutate each other's view
+        # and dodge the 409 arbitration under test
+        return _ns(
+            metadata=_ns(name=lease.metadata.name,
+                         resource_version=lease.metadata.resource_version),
+            spec=_ns(**vars(lease.spec)),
+        )
+
+    def read_namespaced_lease(self, name, namespace):
+        lease = self._store.leases.get((namespace, name))
+        if lease is None:
+            raise ApiException(404, "lease not found")
+        return self._copy(lease)
+
+    def create_namespaced_lease(self, namespace, body):
+        key = (namespace, body.metadata.name)
+        if key in self._store.leases:
+            raise ApiException(409, "lease exists")
+        self._store.resource_version += 1
+        body.metadata.resource_version = str(self._store.resource_version)
+        self._store.leases[key] = self._copy(body)
+        return body
+
+    def replace_namespaced_lease(self, name, namespace, body):
+        current = self._store.leases.get((namespace, name))
+        if current is None:
+            raise ApiException(404, "lease not found")
+        if current.metadata.resource_version != body.metadata.resource_version:
+            raise ApiException(409, "conflict")
+        self._store.resource_version += 1
+        body.metadata.resource_version = str(self._store.resource_version)
+        self._store.leases[(namespace, name)] = self._copy(body)
+        return body
 
 
 class Watch:
@@ -206,8 +262,11 @@ class Watch:
 
     def stream(self, list_fn, **kwargs):
         self._store.watch_stream_kwargs.append(dict(kwargs))
+        feed = (self._store.node_watch_feed
+                if getattr(list_fn, "__name__", "") == "list_node"
+                else self._store.watch_feed)
         while True:
-            item = self._store.watch_feed.get()
+            item = feed.get()
             if item is STREAM_END:
                 return
             if isinstance(item, Exception):
@@ -224,9 +283,18 @@ def build_modules(store: FakeStore):
     client_mod = types.ModuleType("kubernetes.client")
     client_mod.ApiException = ApiException
     client_mod.CoreV1Api = lambda: CoreV1Api(store)
+    client_mod.CoordinationV1Api = lambda: CoordinationV1Api(store)
     client_mod.V1Binding = lambda metadata, target: _ns(
         metadata=metadata, target=target)
     client_mod.V1ObjectMeta = lambda name: _ns(name=name)
+    client_mod.V1Lease = lambda metadata, spec: _ns(
+        metadata=metadata, spec=spec)
+    client_mod.V1LeaseSpec = (
+        lambda holder_identity, lease_duration_seconds, acquire_time,
+        renew_time: _ns(
+            holder_identity=holder_identity,
+            lease_duration_seconds=lease_duration_seconds,
+            acquire_time=acquire_time, renew_time=renew_time))
     client_mod.V1ObjectReference = lambda api_version, kind, name: _ns(
         api_version=api_version, kind=kind, name=name)
 

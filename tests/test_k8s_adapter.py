@@ -260,3 +260,118 @@ class TestK8sIntegration:
         obj = store.put_pod("ns", "after-end")
         store.emit("ADDED", obj)
         assert self._wait_for(lambda: ("add", "after-end") in events)
+
+
+class TestLeaderLease:
+    """coordination.k8s.io/v1 lease arbitration through the adapter
+    (VERDICT r4 #7): two K8sCluster instances against one fake apiserver
+    — one holds, the other reads the holder; expiry hands over."""
+
+    def test_lease_arbitrates_two_instances(self, fake_cluster):
+        import time as _time
+
+        from kubeshare_tpu.cluster.k8s import K8sCluster
+
+        cluster_a, store = fake_cluster
+        cluster_b = K8sCluster()  # same fake apiserver (same store)
+        assert cluster_a.lease_tryhold("sched", "a", 1.0, 0.0) == "a"
+        # b sees a's unexpired hold
+        assert cluster_b.lease_tryhold("sched", "b", 1.0, 0.0) == "a"
+        # a renews fine
+        assert cluster_a.lease_tryhold("sched", "a", 1.0, 0.0) == "a"
+        # a stops renewing; after the lease duration b takes over
+        _time.sleep(1.1)
+        assert cluster_b.lease_tryhold("sched", "b", 1.0, 0.0) == "b"
+        assert cluster_a.lease_tryhold("sched", "a", 1.0, 0.0) == "b"
+        lease = store.leases[("kube-system", "sched")]
+        assert lease.spec.holder_identity == "b"
+
+    def test_elector_degrades_without_lease_support(self):
+        from kubeshare_tpu.cluster.api import ClusterAPI
+        from kubeshare_tpu.scheduler.leader import LeaderElector
+
+        elector = LeaderElector(ClusterAPI(), "solo")
+        assert elector.is_leader()  # NotImplementedError -> single-instance
+        assert elector.is_leader()
+
+
+class TestSchedulerOver410Storm:
+    """The full scheduler stack over K8sCluster must keep binding exactly
+    once per pod through a mid-cycle 410-Gone resync storm (watch history
+    compacted repeatedly while pods are in flight) — VERDICT r4 #7's
+    apiserver-resilience case."""
+
+    def test_pods_bind_exactly_once_through_storm(self, fake_cluster):
+        import time as _time
+
+        from kubeshare_tpu import constants
+        from kubeshare_tpu.cell import load_config
+        from kubeshare_tpu.cell.allocator import ChipInfo
+        from kubeshare_tpu.scheduler import (
+            KubeShareScheduler, SchedulerArgs, SchedulerEngine)
+
+        cluster, store = fake_cluster
+        store.put_node("node-1", labels={constants.NODE_LABEL_FILTER: "true"})
+        topology = """
+cellTypes:
+  V4-NODE:
+    childCellType: "TPU-v4"
+    childCellNumber: 4
+    childCellPriority: 60
+    isNodeLevel: true
+cells:
+- cellType: V4-NODE
+  cellId: node-1
+"""
+        inventory = {
+            "node-1": [ChipInfo(f"node-1-tpu-{i}", 32 << 30, "TPU-v4", i)
+                       for i in range(4)],
+        }
+        plugin = KubeShareScheduler(
+            topology=load_config(text=topology),
+            cluster=cluster,
+            inventory=lambda node: inventory.get(node, []),
+            args=SchedulerArgs(),
+        )
+        engine = SchedulerEngine(plugin, cluster)
+
+        def wait_pending(n, deadline_s=5.0):
+            deadline = _time.time() + deadline_s
+            while _time.time() < deadline:
+                if len(engine.pending_pods()) >= n:
+                    return True
+                _time.sleep(0.02)
+            return False
+
+        labels = {constants.POD_GPU_LIMIT: "1.0",
+                  constants.POD_GPU_REQUEST: "0.5"}
+        total = 6
+        for i in range(total):
+            obj = store.put_pod("ns", f"w{i}", labels=dict(labels))
+            store.emit("ADDED", obj)
+            if i % 2 == 0:
+                # compaction mid-cycle: the watch raises 410 Gone with
+                # this pod's ADDED possibly unconsumed — it must surface
+                # via the resync list instead of getting lost
+                store.emit_error(fake_kubernetes.ApiException(410, "Gone"))
+            assert wait_pending(1), f"pod w{i} never reached the engine"
+            result = engine.run_once()
+            # a cycle may land on a stale already-bound entry while the
+            # fresh pod's event is in flight (eventually-consistent watch);
+            # idempotent re-scheduling answers "bound" with NO second bind
+            assert result is not None and result.result == "bound", result
+            # another storm AFTER binding: the resync must not resurrect
+            # the bound pod into the pending set or unbind it
+            store.emit_error(fake_kubernetes.ApiException(410, "Gone"))
+
+        # drain: keep cycling until the event stream settles and every
+        # pod is bound (resyncs redeliver; cycles on stale entries no-op)
+        deadline = _time.time() + 10.0
+        while _time.time() < deadline and len(store.bindings) < total:
+            engine.run_once()
+            _time.sleep(0.02)
+        # exactly one bind subresource call per pod — no duplicate binds
+        # from resync replays, no lost pods
+        assert len(store.bindings) == total
+        assert sorted(n for _, n, _ in store.bindings) == [
+            f"w{i}" for i in range(total)]
