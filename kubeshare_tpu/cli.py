@@ -181,13 +181,10 @@ def cmd_scheduler(args: argparse.Namespace) -> int:
         log.info("scheduler metrics on :%d/metrics", metric_server.port)
     elector = None
     if getattr(args, "leader_elect", False):
-        import os as _os
-        import socket as _socket
-
         from .scheduler.leader import LeaderElector
 
         identity = args.leader_identity or (
-            f"{_socket.gethostname()}-{_os.getpid()}")
+            f"{socket.gethostname()}-{os.getpid()}")
         elector = LeaderElector(
             cluster, identity, lease_duration_s=args.lease_duration)
         log.info("leader election on (identity=%s)", identity)

@@ -67,9 +67,19 @@ class SchedulerEngine:
         if not isinstance(pod, Pod) or pod.scheduler_name != constants.SCHEDULER_NAME:
             return
         if event == "delete" or pod.is_bound() or pod.is_completed():
-            self._pending.pop(pod.key, None)
+            self._forget(pod.key)
         else:
             self._pending[pod.key] = pod
+
+    def _forget(self, pod_key: str) -> None:
+        """Drop a pod that left the queue terminally (bound / completed /
+        deleted) from every per-pod map — the sort-key and attempt-stamp
+        caches would otherwise grow one entry per pod for the process
+        lifetime (pod churn on an HA leader runs for weeks)."""
+        self._pending.pop(pod_key, None)
+        self._sort_keys.pop(pod_key, None)
+        self._sort_key_uids.pop(pod_key, None)
+        self._attempt_timestamps.pop(pod_key, None)
 
     # ------------------------------------------------------------------
     def pending_pods(self) -> List[Pod]:
@@ -145,10 +155,10 @@ class SchedulerEngine:
         # placement annotations).
         current = self.cluster.get_pod(pod.namespace, pod.name)
         if current is None:
-            self._pending.pop(pod.key, None)
+            self._forget(pod.key)
             return CycleStatus(pod.key, "stale", "pod no longer exists")
         if current.is_bound() or current.is_completed():
-            self._pending.pop(pod.key, None)
+            self._forget(pod.key)
             return CycleStatus(pod.key, "bound", "already placed",
                                current.node_name)
         pod = current
@@ -187,6 +197,7 @@ class SchedulerEngine:
 
         self._bind(pod, best.name)
         self._allow_group(pod)
+        self._forget(pod.key)  # terminal: no event round-trip needed
         return CycleStatus(pod.key, "bound", "", best.name)
 
     def _bind(self, pod: Pod, node_name: str) -> None:

@@ -62,6 +62,7 @@ class LeaderElector:
         self._next_attempt = float("-inf")
         self._degraded = False
         self._error_logged = False
+        self._first_error_at: Optional[float] = None
 
     def is_leader(self) -> bool:
         now = self.clock.now()
@@ -89,9 +90,21 @@ class LeaderElector:
             # apiserver hiccup: retry soon; hold the leader answer only
             # inside the renew deadline (see class docstring)
             self._next_attempt = now + self.retry_period_s
+            if self._first_error_at is None:
+                self._first_error_at = now
             if not self._error_logged:
                 self.log.warning("lease attempt failed (will retry): %s", e)
                 self._error_logged = True
+            if now - self._first_error_at > 4 * self.lease_duration_s:
+                # not a blip: a persistently failing election (RBAC denies
+                # leases, wrong namespace, ...) must not degrade to a
+                # scheduler that silently never schedules — fail loudly,
+                # like kube-scheduler exiting when its elector dies
+                raise RuntimeError(
+                    f"leader election failing for over "
+                    f"{4 * self.lease_duration_s:.0f}s "
+                    f"(lease {self.lease_name!r}): {e}"
+                ) from e
             if (self._was_leader
                     and now - self._last_renew < self.renew_deadline_s):
                 return True
@@ -102,6 +115,7 @@ class LeaderElector:
                 self._was_leader = False
             return False
         self._error_logged = False
+        self._first_error_at = None
         leading = holder == self.identity
         if leading:
             self._last_renew = now

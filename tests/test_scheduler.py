@@ -1044,3 +1044,27 @@ class TestLeaderElection:
         cluster.broken = False
         clock.advance(1.0)
         assert elector.is_leader()
+
+    def test_persistent_lease_failure_escalates(self):
+        """A misconfigured election (e.g. RBAC denies leases) must fail
+        loudly after ~4 lease durations, not leave a scheduler that
+        silently never schedules (kube-scheduler exits likewise)."""
+        from kubeshare_tpu.cluster.api import ClusterAPI, FakeClock
+        from kubeshare_tpu.scheduler.leader import LeaderElector
+
+        class DeniedCluster(ClusterAPI):
+            def lease_tryhold(self, name, identity, duration_s, now):
+                raise ConnectionError("403 forbidden")
+
+        clock = FakeClock(0.0)
+        elector = LeaderElector(DeniedCluster(), "a", lease_duration_s=15.0,
+                                clock=clock)
+        for _ in range(25):  # 50s of failing retries at ~2s cadence
+            assert not elector.is_leader()
+            clock.advance(2.1)
+            if clock.now() > 60.0:
+                break
+        with pytest.raises(RuntimeError, match="leader election failing"):
+            while True:
+                elector.is_leader()
+                clock.advance(2.1)
