@@ -15,6 +15,8 @@
 #   out/kernel_window.json   re-measured sliding-window headline
 #   out/kernel_model.json    flagship/wide/moe MFU
 #   out/kernel_moe.json      MoE dispatch einsum-vs-scatter MFU
+#   out/kernel_chunk.json    width-C cached step vs serial steps
+#   out/kernel_spec.json     speculative decoding tokens/s at accept bounds
 set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-benchmarks/out}"
@@ -86,5 +88,9 @@ gap
 run 1200 "width-C cached step vs serial steps (prefill/speculation win)" \
     "$OUT/kernel_chunk.json" \
     python benchmarks/kernel_bench.py --suite chunk
+gap
+run 1800 "speculative decoding end-to-end (accept-rate bounds)" \
+    "$OUT/kernel_spec.json" \
+    python benchmarks/kernel_bench.py --suite spec
 
 echo "== done; update docs/perf.md from $OUT =="

@@ -374,6 +374,7 @@ def speculative_greedy_decode(
     prompt: jax.Array,
     max_new_tokens: int,
     draft_len: int = 4,
+    return_stats: bool = False,
 ) -> jax.Array:
     """Greedy generation with draft-model speculation: matches
     :func:`greedy_decode`'s token stream up to floating-point argmax
@@ -396,7 +397,11 @@ def speculative_greedy_decode(
     The verify chunk writes its K/V optimistically; rejected positions
     are simply masked out by the rewound cache length and overwritten by
     the next round.  Both models must share a vocabulary; the caches
-    need headroom of ``draft_len`` beyond the generated text."""
+    need headroom of ``draft_len`` beyond the generated text.  With
+    ``return_stats`` the result is ``(tokens, {"rounds": r})`` — r counts
+    target verify passes (the speculation speedup's denominator; on real
+    hardware near-tied argmaxes can reject even a self-draft, so measured
+    ceilings should report it)."""
     batch, prompt_len = prompt.shape
     _check_speculative_args(config, draft_config, prompt_len,
                             max_new_tokens, draft_len)
@@ -408,11 +413,10 @@ def speculative_greedy_decode(
     out = out.at[:, 0].set(first)
 
     def cond(state):
-        _, _, _, n_done, _ = state
-        return n_done < max_new_tokens
+        return state[3] < max_new_tokens
 
     def body(state):
-        cache, dcache, out, n_done, last = state
+        cache, dcache, out, n_done, last, rounds = state
 
         # 1. draft proposes draft_len-1 tokens after `last`.  The scan
         # runs draft_len steps: the final step feeds p_{k-1} (its output
@@ -457,11 +461,12 @@ def speculative_greedy_decode(
         cache = dict(cache, length=target_length + m + 1)
         dcache = dict(dcache, length=target_length + m + 1)
         last = stream[:, m]
-        return cache, dcache, out, n_done + m + 1, last
+        return cache, dcache, out, n_done + m + 1, last, rounds + 1
 
-    _, _, out, _, _ = jax.lax.while_loop(
-        cond, body, (cache, dcache, out, jnp.int32(1), first))
-    return out[:, :max_new_tokens]
+    _, _, out, _, _, rounds = jax.lax.while_loop(
+        cond, body, (cache, dcache, out, jnp.int32(1), first, jnp.int32(0)))
+    tokens = out[:, :max_new_tokens]
+    return (tokens, {"rounds": rounds}) if return_stats else tokens
 
 
 def speculative_sample_decode(

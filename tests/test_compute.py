@@ -1534,6 +1534,15 @@ class TestSpeculativeSampling:
             jax.random.PRNGKey(3), 12, draft_len=3, return_stats=True)
         assert out.shape == (2, 12)
         assert int(stats["rounds"]) == 4  # ceil(11 / 3)
+        # the greedy variant exposes the same stat (benchmarks report
+        # measured tokens-per-target-pass rather than assuming accept=1)
+        from kubeshare_tpu.models.decoding import speculative_greedy_decode
+
+        gout, gstats = speculative_greedy_decode(
+            params, config, params, config, prompt, 12, draft_len=3,
+            return_stats=True)
+        assert gout.shape == (2, 12)
+        assert int(gstats["rounds"]) == 4
 
     def test_temperature_zero_delegates_to_greedy(self):
         from kubeshare_tpu.models.decoding import (

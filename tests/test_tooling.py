@@ -193,6 +193,30 @@ class TestDeployManifests:
         elements, _, _ = build_cell_chains(config.cell_types)
         assert any(e.is_multi_nodes for e in elements.values())
 
+    def test_multislice_topology_marks_slice_level(self):
+        """The multislice example must carry the isSliceLevel marker the
+        DCN tier and megascale env injection key off, and its two marked
+        slices must resolve to distinct slice keys despite the shared
+        region root."""
+        from kubeshare_tpu.cell import (build_cell_chains, build_cell_forest,
+                                        load_config)
+        from kubeshare_tpu.cell.topology import slice_key
+
+        config = load_config(path=os.path.join(
+            self.DEPLOY, "config", "kubeshare-config-multislice.yaml"))
+        slice_types = frozenset(
+            name for name, t in config.cell_types.items() if t.is_slice_level)
+        assert slice_types == {"TPU-v5e-SLICE"}
+        elements, _, _ = build_cell_chains(config.cell_types)
+        forest = build_cell_forest(elements, config.cells)
+        keys = set()
+        for by_level in forest.values():
+            for roots in by_level.values():
+                for root in roots:
+                    for leaf in root.leaves():
+                        keys.add(slice_key(leaf, slice_types))
+        assert len(keys) == 2  # two ICI domains under one root
+
 
 class TestExampleWorkloadManifests:
     """Every examples/*.yaml pod manifest must parse AND place through the
