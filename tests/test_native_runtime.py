@@ -206,6 +206,49 @@ class TestTokend:
         client.close()
         server.close()
 
+    def test_exclusive_reqb_contention_progresses(self, tmp_path):
+        """Lost-wakeup stress for the REQB park/notify path: several
+        clients fighting over an exclusive chip must all keep making
+        progress — a missed notify would strand a parked waiter until
+        its 2s window expires (visible as a collapsed grant count)."""
+        proc, info = _start_tokend(
+            tmp_path,
+            config=("4\nns/p0 1.0 0.25 0\nns/p1 1.0 0.25 0\n"
+                    "ns/p2 1.0 0.25 0\nns/p3 1.0 0.25 0\n"),
+            exclusive=True)
+        try:
+            counts = {}
+            lock = threading.Lock()
+
+            def worker(pod):
+                client = TokenClient("127.0.0.1", info["port"], pod)
+                done = 0
+                stop = time.monotonic() + 2.0
+                while time.monotonic() < stop:
+                    client.acquire()
+                    client.release(0.5)
+                    done += 1
+                with lock:
+                    counts[pod] = done
+                client.close()
+
+            threads = [threading.Thread(target=worker, args=(f"ns/p{i}",))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=15)
+            assert all(not t.is_alive() for t in threads), counts
+            # every worker finished NORMALLY (a crashed worker never
+            # writes its count — an empty/partial dict must fail, not
+            # pass vacuously) and made real progress (a stranded waiter
+            # would show single-digit counts from repeated park expiries)
+            assert sorted(counts) == [f"ns/p{i}" for i in range(4)], counts
+            assert all(c >= 50 for c in counts.values()), counts
+        finally:
+            proc.kill()
+            proc.wait()
+
     def test_client_honors_hint_from_poll_shaped_server(self):
         """A WAIT answered well before the park window (old daemon or the
         -G gang gate, which degrades REQB to poll-shaped) must make the
