@@ -508,16 +508,9 @@ def speculative_sample_decode(
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     _filter_logits(jnp.zeros((1, 2)), top_k, top_p)
     if temperature == 0.0:
-        # greedy delegation has no stats channel: its round count lives
-        # in speculative_greedy_decode's own structure, and inventing a
-        # sentinel here would silently corrupt speedup arithmetic
-        if return_stats:
-            raise ValueError(
-                "return_stats is unavailable at temperature=0 (the call "
-                "delegates to speculative_greedy_decode)")
         return speculative_greedy_decode(
             params, config, draft_params, draft_config, prompt,
-            max_new_tokens, draft_len)
+            max_new_tokens, draft_len, return_stats=return_stats)
 
     def log_dist(logits):
         # filtered + temperature-scaled log-distribution over the last

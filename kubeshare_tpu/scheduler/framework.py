@@ -153,7 +153,14 @@ class SchedulerEngine:
         # queue head forever and, worse, re-reserve cells it already
         # holds under a fresh uuid (the stale snapshot carries no
         # placement annotations).
-        current = self.cluster.get_pod(pod.namespace, pod.name)
+        try:
+            current = self.cluster.get_pod(pod.namespace, pod.name)
+        except Exception as e:
+            # a transient apiserver error (500/429/timeout) must not
+            # crash the scheduler out of its loop — report an error
+            # cycle and let the caller's backoff retry (the elector one
+            # layer up absorbs the same hiccup for its renew deadline)
+            return CycleStatus(pod.key, "error", f"pod re-fetch failed: {e}")
         if current is None:
             self._forget(pod.key)
             return CycleStatus(pod.key, "stale", "pod no longer exists")

@@ -375,3 +375,55 @@ cells:
         assert len(store.bindings) == total
         assert sorted(n for _, n, _ in store.bindings) == [
             f"w{i}" for i in range(total)]
+
+    def test_transient_apiserver_error_does_not_crash_cycle(self, fake_cluster):
+        """A 500 during the cycle's authoritative re-fetch must come back
+        as an 'error' cycle for the loop's backoff, not crash the
+        scheduler process."""
+        import time as _time
+
+        from kubeshare_tpu import constants
+        from kubeshare_tpu.cell import load_config
+        from kubeshare_tpu.cell.allocator import ChipInfo
+        from kubeshare_tpu.scheduler import (
+            KubeShareScheduler, SchedulerArgs, SchedulerEngine)
+
+        cluster, store = fake_cluster
+        store.put_node("node-1", labels={constants.NODE_LABEL_FILTER: "true"})
+        topology = """
+cellTypes:
+  V4-NODE:
+    childCellType: "TPU-v4"
+    childCellNumber: 4
+    childCellPriority: 60
+    isNodeLevel: true
+cells:
+- cellType: V4-NODE
+  cellId: node-1
+"""
+        inventory = {
+            "node-1": [ChipInfo(f"node-1-tpu-{i}", 32 << 30, "TPU-v4", i)
+                       for i in range(4)],
+        }
+        plugin = KubeShareScheduler(
+            topology=load_config(text=topology), cluster=cluster,
+            inventory=lambda node: inventory.get(node, []),
+            args=SchedulerArgs())
+        engine = SchedulerEngine(plugin, cluster)
+        labels = {constants.POD_GPU_LIMIT: "1.0",
+                  constants.POD_GPU_REQUEST: "0.5"}
+        obj = store.put_pod("ns", "w0", labels=dict(labels))
+        store.emit("ADDED", obj)
+        deadline = _time.time() + 3.0
+        while _time.time() < deadline and not engine.pending_pods():
+            _time.sleep(0.02)
+
+        real_read = cluster.core.read_namespaced_pod
+        cluster.core.read_namespaced_pod = lambda *a, **k: (_ for _ in ()).throw(
+            fake_kubernetes.ApiException(500, "boom"))
+        result = engine.run_once()
+        assert result is not None and result.result == "error"
+        # apiserver recovers: the same pod binds on the next cycle
+        cluster.core.read_namespaced_pod = real_read
+        result = engine.run_once()
+        assert result is not None and result.result == "bound"

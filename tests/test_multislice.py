@@ -203,6 +203,34 @@ class TestMegascaleEnv:
             )
         assert slice_ids == {"0", "1"}
 
+    def test_four_member_gang_splits_two_per_slice(self):
+        """A 4-member whole-node gang over two 2-host slices must plan
+        the uniform 2+2 layout: every member gets per-slice
+        TPU_PROCESS_BOUNDS of 2 processes and a slice id shared with
+        exactly one peer."""
+        cluster, plugin, engine = make_env(TWO_SLICE_TOPOLOGY, TWO_SLICE_INVENTORY)
+        for i in range(4):
+            cluster.create_pod(gang_pod(f"w{i}", "grid", 4))
+        engine.run_until_idle()
+        by_slice = {}
+        for i in range(4):
+            pod = cluster.get_pod("default", f"w{i}")
+            assert pod.is_bound()
+            env = pod.containers[0].env
+            assert env[constants.ENV_MEGASCALE_NUM_SLICES] == "2"
+            assert env[constants.ENV_PROCESS_BOUNDS] == "2,1,1"
+            assert env[constants.ENV_CHIPS_PER_PROCESS_BOUNDS] == "4,1,1"
+            by_slice.setdefault(
+                env[constants.ENV_MEGASCALE_SLICE_ID], []).append(i)
+        assert sorted(len(v) for v in by_slice.values()) == [2, 2]
+        # placement agrees with the bootstrap: same slice id -> same
+        # physical slice
+        for members in by_slice.values():
+            slices = {node_slice(
+                plugin, cluster.get_pod("default", f"w{i}").node_name)
+                for i in members}
+            assert len(slices) == 1
+
     def test_uneven_capacity_degrades_to_linear_gang_grid(self):
         """libtpu multi-slice needs identically-shaped slices.  A gang of
         3 whole-node members over a 2-host slice + 1-host slice has no
