@@ -8,6 +8,7 @@
 #
 # Produces (all JSON-lines):
 #   out/probe.txt            device probe result
+#   out/shim_real.txt        live-runtime validation of the current shim
 #   out/bench_train.json     cooperative + adversarial north star
 #   out/bench_serve.json     fractional-serving ratio + p50/p95
 #   out/kernel_fwd.json      3x fwd repeats (median harness) incl (1,4,8192,128)
@@ -56,6 +57,13 @@ if ! probe; then
   exit 1
 fi
 cat "$OUT/probe.txt"
+gap
+
+# validate the CURRENT shim binary against the live runtime first (the
+# interposer has grown since its last live validation; these two tests
+# skip on CPU-only hosts, so a live run is the only place they bind)
+run 1200 "real-runtime shim validation" "$OUT/shim_real.txt" \
+    python -m pytest tests/test_shim_real_runtime.py -v
 gap
 
 run 1800 "north star (cooperative + adversarial)" "$OUT/bench_train.json" \

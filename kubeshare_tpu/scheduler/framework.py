@@ -115,13 +115,28 @@ class SchedulerEngine:
 
     # ------------------------------------------------------------------
     def run_once(self) -> Optional[CycleStatus]:
-        """Schedule the head-of-queue pod through one full cycle."""
+        """Schedule the head-of-queue pod through one full cycle.
+
+        The WHOLE cycle is error-guarded: any of its apiserver calls
+        (re-fetch, list_nodes, the reserve patch, the bind subresource)
+        can hit a transient 500/429/timeout, and none of them may crash
+        the scheduler out of its loop — the cycle reports ``"error"``
+        and the caller's backoff retries.  The full traceback is logged
+        so a DETERMINISTIC failure (a bug, not a hiccup) repeating on
+        the head-of-queue pod stays loudly visible rather than silently
+        reclassified as weather."""
         self.expire_waiting_pods()
         self.plugin.pod_groups.gc()  # ref pod_group.go:119-129 (30s loop)
         pending = self.pending_pods()
         if not pending:
             return None
-        return self.schedule_pod(pending[0])
+        pod = pending[0]
+        try:
+            return self.schedule_pod(pod)
+        except Exception as e:
+            self.log.warning("scheduling cycle for %s failed (will back "
+                             "off and retry): %s", pod.key, e, exc_info=True)
+            return CycleStatus(pod.key, "error", f"cycle failed: {e}")
 
     def run_until_idle(self, max_cycles: int = 1000) -> List[CycleStatus]:
         """Drive cycles until nothing schedulable remains (tests/simulator)."""
@@ -153,14 +168,7 @@ class SchedulerEngine:
         # queue head forever and, worse, re-reserve cells it already
         # holds under a fresh uuid (the stale snapshot carries no
         # placement annotations).
-        try:
-            current = self.cluster.get_pod(pod.namespace, pod.name)
-        except Exception as e:
-            # a transient apiserver error (500/429/timeout) must not
-            # crash the scheduler out of its loop — report an error
-            # cycle and let the caller's backoff retry (the elector one
-            # layer up absorbs the same hiccup for its renew deadline)
-            return CycleStatus(pod.key, "error", f"pod re-fetch failed: {e}")
+        current = self.cluster.get_pod(pod.namespace, pod.name)
         if current is None:
             self._forget(pod.key)
             return CycleStatus(pod.key, "stale", "pod no longer exists")
