@@ -37,6 +37,7 @@ from kubeshare_tpu.cluster.api import FakeClock, Node, Pod, PodPhase  # noqa: E4
 from kubeshare_tpu.configd import ConfigDaemon  # noqa: E402
 from kubeshare_tpu.cluster.fake import FakeCluster  # noqa: E402
 from kubeshare_tpu.isolation import ExecutionGuard, TokenClient  # noqa: E402
+from kubeshare_tpu.utils.net import wait_listening  # noqa: E402
 from kubeshare_tpu.models import mnist_apply, mnist_init  # noqa: E402
 from kubeshare_tpu.parallel.train import cross_entropy_loss, make_train_step  # noqa: E402
 from kubeshare_tpu.runtime import ChipSupervisor  # noqa: E402
@@ -103,7 +104,10 @@ def main() -> None:
     tokend_port = s.getsockname()[1]; s.close()
     with ChipSupervisor(chip, config_dir=config_dir, port_dir=port_dir,
                         tokend_port=tokend_port, poll_interval=0.2) as sup:
-        time.sleep(1.0)
+        wait_listening(tokend_port)
+        for name in ("mnist-a", "mnist-b"):
+            pod = cluster.get_pod("default", name)
+            wait_listening(int(pod.annotations[constants.POD_MANAGER_PORT]))
         print(f"tokend on :{tokend_port}, pod managers: "
               f"{sorted(sup.pod_managers)}")
 

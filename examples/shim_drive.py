@@ -99,13 +99,9 @@ def main() -> int:
          "-q", "300", "-m", "20", "-w", "10000"],
     )
     try:
-        deadline = time.time() + 10
-        while time.time() < deadline:
-            try:
-                socket.create_connection(("127.0.0.1", port), timeout=1).close()
-                break
-            except OSError:
-                time.sleep(0.05)
+        from kubeshare_tpu.utils.net import wait_listening
+
+        wait_listening(port, deadline_s=10)
 
         env = dict(os.environ)
         env.update({

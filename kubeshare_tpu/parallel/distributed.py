@@ -71,6 +71,118 @@ def spec_from_env(environ: Optional[Mapping[str, str]] = None) -> Optional[Distr
     return DistributedSpec(coordinator, size, rank)
 
 
+@dataclass(frozen=True)
+class MultisliceSpec:
+    """The DCN tier of the scheduler's bootstrap contract.
+
+    On real hardware libtpu consumes the MEGASCALE_* env directly and
+    stitches the slices over DCN; this spec is the workload-visible view
+    of the same contract, so a training script can build a mesh whose
+    outer axis is the slice boundary (collectives on that axis ride DCN,
+    everything inner rides ICI) — the layout SURVEY §5 mandates.
+    """
+
+    num_slices: int
+    slice_id: int
+    processes_per_slice: int
+
+
+def multislice_spec_from_env(
+    environ: Optional[Mapping[str, str]] = None,
+) -> Optional[MultisliceSpec]:
+    """Read the scheduler-injected MEGASCALE env; None when single-slice.
+
+    Single-slice gangs get no MEGASCALE env at all (plugin.py injects it
+    only for cross-slice plans), so None is the common case.
+    """
+    env = environ if environ is not None else os.environ
+    slice_id_raw = env.get(constants.ENV_MEGASCALE_SLICE_ID)
+    try:
+        num_slices = int(env.get(constants.ENV_MEGASCALE_NUM_SLICES, "1"))
+    except ValueError:
+        return None
+    if num_slices <= 1:
+        return None
+    # the plugin always injects NUM_SLICES and SLICE_ID together; a
+    # multi-slice count with no id is a broken contract, not slice 0
+    # (every process defaulting to 0 would build a silently wrong mesh)
+    if slice_id_raw is None:
+        return None
+    try:
+        slice_id = int(slice_id_raw)
+    except ValueError:
+        return None
+    if not 0 <= slice_id < num_slices:
+        return None
+    # under megascale the process grid is per-ICI-domain (plugin.py
+    # injects the placing slice's member count, uniform across slices)
+    processes = 1
+    bounds = env.get(constants.ENV_PROCESS_BOUNDS, "")
+    if bounds:
+        try:
+            for b in bounds.split(","):
+                processes *= int(b)
+        except ValueError:
+            processes = 1
+    return MultisliceSpec(num_slices, slice_id, max(1, processes))
+
+
+def slice_device_mesh(
+    ms: MultisliceSpec,
+    axis_names: tuple = ("dcn", "device"),
+) -> "jax.sharding.Mesh":
+    """Global mesh whose OUTER axis is the slice boundary.
+
+    On real multislice TPU every device carries ``slice_index`` and the
+    grouping is read straight off the hardware.  Elsewhere (the CPU
+    dryrun analogue) each process knows only its own slice id, so the
+    processes allgather their ids once and group devices by owning
+    process.  Either way the returned mesh is (num_slices, -1): shard
+    data-parallel axes on ``dcn`` (allreduce-tolerant of DCN latency),
+    keep tensor/sequence axes inner where collectives ride ICI.
+    """
+    import jax
+    import numpy as np
+
+    devices = jax.devices()
+    if len(devices) % ms.num_slices != 0:
+        raise ValueError(
+            f"{len(devices)} devices do not tile {ms.num_slices} slices"
+        )
+    hw_slices = {getattr(d, "slice_index", None) for d in devices}
+    if None not in hw_slices and len(hw_slices) == ms.num_slices:
+        # real multislice: the runtime stamps every device's slice and
+        # the stamps partition into exactly num_slices groups.  (A
+        # single-slice-looking stamp set — e.g. CPU devices all report
+        # slice_index 0 — means the attribute does NOT carry the DCN
+        # layout; group by process instead.)
+        slice_of = {d: d.slice_index for d in devices}
+    else:
+        from jax.experimental import multihost_utils
+
+        gathered = np.asarray(
+            multihost_utils.process_allgather(np.array([ms.slice_id]))
+        ).reshape(-1)
+        proc_slice = {p: int(s) for p, s in enumerate(gathered)}
+        slice_of = {d: proc_slice[d.process_index] for d in devices}
+    per_slice = len(devices) // ms.num_slices
+    counts = {}
+    for d in devices:
+        counts[slice_of[d]] = counts.get(slice_of[d], 0) + 1
+    if counts != {s: per_slice for s in range(ms.num_slices)}:
+        # an uneven grouping reshaped anyway would mix slices within a
+        # mesh row and run 'dcn' collectives over wrong groups
+        raise ValueError(
+            f"devices group unevenly across slices: {counts} "
+            f"(expected {per_slice} in each of {ms.num_slices})"
+        )
+    ordered = sorted(
+        devices, key=lambda d: (slice_of[d], d.process_index, d.id)
+    )
+    grid = np.array(ordered, dtype=object).reshape(ms.num_slices, -1)
+    return jax.sharding.Mesh(grid, axis_names)
+
+
 def initialize_from_env(environ: Optional[Mapping[str, str]] = None) -> Optional[DistributedSpec]:
     """Call jax.distributed.initialize from gang env; no-op when solo."""
     log = get_logger("kubeshare-distributed")

@@ -1,7 +1,8 @@
 """Shared helpers for tests that drive the native token runtime over TCP."""
 
 import socket
-import time
+
+from kubeshare_tpu.utils.net import wait_listening as _wait_listening
 
 
 def free_port():
@@ -13,11 +14,4 @@ def free_port():
 
 
 def wait_listening(port, timeout=10.0):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        try:
-            socket.create_connection(("127.0.0.1", port), timeout=1).close()
-            return
-        except OSError:
-            time.sleep(0.05)
-    raise TimeoutError(f"nothing listening on {port}")
+    _wait_listening(port, deadline_s=timeout)

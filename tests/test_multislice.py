@@ -290,3 +290,166 @@ cells:
             env = pod.containers[0].env
             assert constants.ENV_MEGASCALE_NUM_SLICES not in env
             assert constants.ENV_MEGASCALE_SLICE_ID not in env
+
+
+class TestMegascaleBootstrapDrive:
+    """The injected MEGASCALE env consumed end-to-end (ROADMAP r5 #3):
+    the scheduler places a cross-slice gang, then two OS processes
+    carrying each bound pod's ACTUAL container env build the DCN-outer
+    mesh the env describes and agree on a psum across the slice axis —
+    the single-slice analogue of this chain is
+    test_scheduler.test_gang_env_drives_distributed_workload."""
+
+    def test_megascale_env_drives_cross_slice_psum(self, tmp_path):
+        import os
+        import subprocess
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from native_helpers import free_port
+
+        cluster, plugin, engine = make_env(
+            MARKED_SLICE_TOPOLOGY, MARKED_SLICE_INVENTORY
+        )
+        for i in range(2):
+            cluster.create_pod(gang_pod(f"w{i}", "big", 2))
+        engine.run_until_idle()
+        assert all(
+            cluster.get_pod("default", f"w{i}").is_bound() for i in range(2)
+        )
+
+        port = free_port()
+        worker = tmp_path / "megascale_worker.py"
+        worker.write_text(
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "import numpy as np\n"
+            "from jax.sharding import PartitionSpec as P\n"
+            "from jax.experimental import multihost_utils\n"
+            "from kubeshare_tpu.parallel.distributed import (\n"
+            "    initialize_from_env, multislice_spec_from_env,\n"
+            "    slice_device_mesh)\n"
+            "ms = multislice_spec_from_env()\n"
+            "assert ms is not None and ms.num_slices == 2, ms\n"
+            "assert ms.processes_per_slice == 1, ms\n"
+            "spec = initialize_from_env()\n"
+            "assert spec is not None and spec.num_processes == 2\n"
+            "mesh = slice_device_mesh(ms)\n"
+            "assert mesh.devices.shape == (2, 1), mesh.devices.shape\n"
+            "# my device must land in MY slice's row of the mesh\n"
+            "assert (mesh.devices[ms.slice_id, 0].process_index\n"
+            "        == jax.process_index())\n"
+            "f = jax.jit(jax.shard_map(\n"
+            "    lambda x: jax.lax.psum(x, 'dcn'), mesh=mesh,\n"
+            "    in_specs=P('dcn'), out_specs=P()))\n"
+            "x = multihost_utils.host_local_array_to_global_array(\n"
+            "    np.full((1,), float(ms.slice_id + 1)), mesh, P('dcn'))\n"
+            "total = float(f(x).addressable_data(0)[0])\n"
+            "# 1 (slice 0) + 2 (slice 1): both DCN rows contributed\n"
+            "assert total == 3.0, total\n"
+            "print(f'slice {ms.slice_id} dcn_psum_ok {total}')\n"
+        )
+
+        procs = []
+        try:
+            for i in range(2):
+                injected = cluster.get_pod("default", f"w{i}").containers[0].env
+                assert injected[constants.ENV_MEGASCALE_NUM_SLICES] == "2"
+                env = dict(os.environ)
+                env.update(injected)
+                env["TPUSHARE_COORDINATOR"] = f"127.0.0.1:{port}"
+                env["JAX_PLATFORMS"] = "cpu"
+                env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+                env["PYTHONPATH"] = os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__))
+                )
+                env.pop("LD_PRELOAD", None)
+                procs.append(subprocess.Popen(
+                    [sys.executable, str(worker)], env=env,
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    text=True,
+                ))
+            outs = [p.communicate(timeout=180) for p in procs]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+        slices_seen = set()
+        for p, (out, err) in zip(procs, outs):
+            assert p.returncode == 0, f"{out}\n{err}"
+            [marker] = [ln for ln in out.splitlines() if "dcn_psum_ok" in ln]
+            slices_seen.add(marker.split()[1])
+        assert slices_seen == {"0", "1"}
+
+
+class TestMultisliceSpecGuards:
+    def test_num_slices_without_slice_id_is_rejected(self):
+        from kubeshare_tpu.parallel.distributed import multislice_spec_from_env
+
+        # the plugin injects the pair together; a count with no id must
+        # read as "broken contract", not "slice 0"
+        assert multislice_spec_from_env(
+            {constants.ENV_MEGASCALE_NUM_SLICES: "2"}) is None
+        assert multislice_spec_from_env(
+            {constants.ENV_MEGASCALE_NUM_SLICES: "2",
+             constants.ENV_MEGASCALE_SLICE_ID: "junk"}) is None
+        spec = multislice_spec_from_env(
+            {constants.ENV_MEGASCALE_NUM_SLICES: "2",
+             constants.ENV_MEGASCALE_SLICE_ID: "1",
+             constants.ENV_PROCESS_BOUNDS: "2,1,1"})
+        assert spec is not None
+        assert (spec.num_slices, spec.slice_id, spec.processes_per_slice) \
+            == (2, 1, 2)
+
+    def test_uneven_device_grouping_is_rejected(self, monkeypatch):
+        import jax
+        import pytest
+
+        import kubeshare_tpu.parallel.distributed as dist
+
+        class FakeDev:
+            # slice_index stamps partitioning into num_slices groups ->
+            # hardware path, no allgather
+            def __init__(self, i, s):
+                self.id = i
+                self.process_index = 0
+                self.slice_index = s
+
+        devs = [FakeDev(0, 0), FakeDev(1, 0), FakeDev(2, 0), FakeDev(3, 1)]
+        # slice_device_mesh imports jax function-locally, so patch the
+        # real module's devices(), not a dist-level attribute
+        monkeypatch.setattr(jax, "devices", lambda *a, **k: devs)
+        ms = dist.MultisliceSpec(num_slices=2, slice_id=0,
+                                 processes_per_slice=1)
+        with pytest.raises(ValueError, match="unevenly"):
+            # 3+1 grouping tiles 4 % 2 == 0 but must still be rejected
+            dist.slice_device_mesh(ms)
+
+    def test_hardware_slice_stamps_build_the_mesh(self, monkeypatch):
+        """When slice_index partitions cleanly the mesh groups by it,
+        with no cross-process gather."""
+        import jax
+        import pytest
+
+        import kubeshare_tpu.parallel.distributed as dist
+
+        class FakeDev:
+            def __init__(self, i, s):
+                self.id = i
+                self.process_index = i % 2
+                self.slice_index = s
+
+        devs = [FakeDev(0, 1), FakeDev(1, 0), FakeDev(2, 1), FakeDev(3, 0)]
+        monkeypatch.setattr(jax, "devices", lambda *a, **k: devs)
+        # any allgather attempt means the hardware path was NOT taken
+        import jax.experimental.multihost_utils as mh
+        monkeypatch.setattr(
+            mh, "process_allgather",
+            lambda *a, **k: pytest.fail("allgather on the hardware path"))
+        ms = dist.MultisliceSpec(num_slices=2, slice_id=0,
+                                 processes_per_slice=2)
+        mesh = dist.slice_device_mesh(ms)
+        assert mesh.devices.shape == (2, 2)
+        assert [[d.slice_index for d in row]
+                for row in mesh.devices] == [[0, 0], [1, 1]]
