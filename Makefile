@@ -3,6 +3,7 @@
 #
 #   make native          build tokend/pmgr/client/shim into native/build
 #   make test            run the test suite (CPU mesh)
+#   make serve-smoke     continuous-batching serving bench, fast CPU path
 #   make images          build the kubeshare-tpu:latest container image
 #   make image-check     validate everything the Dockerfile needs, sans docker
 #   make e2e-kind        kind-based end-to-end (skips cleanly without kind)
@@ -10,7 +11,7 @@
 IMAGE ?= kubeshare-tpu:latest
 DOCKER ?= $(shell command -v docker || command -v podman)
 
-.PHONY: all native test images image-check e2e-kind tsan clean
+.PHONY: all native test serve-smoke images image-check e2e-kind tsan clean
 
 all: native
 
@@ -22,6 +23,9 @@ tsan:
 
 test:
 	python3 -m pytest tests/ -x -q
+
+serve-smoke:
+	JAX_PLATFORMS=cpu python3 benchmarks/serving_bench.py --smoke
 
 images: image-check
 ifeq ($(strip $(DOCKER)),)

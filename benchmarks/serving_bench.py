@@ -1,0 +1,302 @@
+#!/usr/bin/env python3
+"""Continuous batching vs run-to-completion serving bench (CPU-friendly).
+
+Methodology (the serving section of docs/perf.md records results):
+
+- ONE Poisson arrival trace of mixed-length requests (prompt and output
+  lengths drawn independently) is replayed against two servers built
+  from the same model weights and the SAME KV-cache HBM budget — the
+  resource a fractional-chip serving pod is actually bounded by:
+
+  * **run-to-completion** (the pre-engine serving path): FIFO batches of
+    ``rtc_batch`` requests; each batch pads every prompt to the
+    workload's max prompt bucket, prefills once, and decodes EVERYONE to
+    the workload's max output length in one fused scan — the fixed
+    worst-case shapes static serving must compile for.  Its dense cache
+    reserves ``rtc_batch x max_seq_len`` rows for the whole run; that
+    product IS the KV budget.
+  * **continuous** (serving/engine.py): the same KV bytes as a block
+    pool ((num_blocks-1) x block_size == rtc_batch x max_seq_len rows).
+    Because admission reserves only what a request can actually touch,
+    the same budget funds MORE concurrent slots — paging converts saved
+    HBM into batch parallelism — on top of mid-flight admission, chunked
+    prefill interleave, and per-request retirement.
+
+- Useful tokens = each request's own requested output length (the
+  run-to-completion server generates padding tokens past a request's
+  need; they are not credited).  Aggregate tokens/s = useful tokens /
+  wall time from first arrival to last completion.  TTFT and per-token
+  latency are per-request wall times against the shared trace clock.
+
+- Both servers are warmed up (compiled) before the clock starts, and
+  the zero-recompile property is ASSERTED from jit cache stats after
+  the run — a shape leak that recompiled mid-serve would invalidate the
+  comparison (and, on TPU, the serving pod).
+
+- Ratio methodology follows docs/perf.md: both sides pay the same
+  fixed dispatch/measurement overheads on this host, so the
+  continuous/run-to-completion RATIO is the trustworthy number;
+  absolute tokens/s drift with host load.
+
+Run:
+
+    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --smoke
+    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py            # full
+    make serve-smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+_requested = os.environ.get("JAX_PLATFORMS", "")
+if _requested:
+    jax.config.update("jax_platforms", _requested)
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def smoke_settings() -> dict:
+    """Seconds-fast CPU path (CI, tests/test_serving.py).
+    KV budget: rtc_batch 4 x max_seq 96 = 384 rows = 48 blocks x 8
+    (finer blocks pack the budget tighter — less internal
+    fragmentation per request than coarse blocks would leave)."""
+    return dict(
+        d_model=128, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=256,
+        vocab_size=512, max_seq_len=96,
+        num_requests=24, rtc_batch=4,
+        num_slots=6, block_size=8, num_blocks=49,
+        max_request_len=96, prefill_chunk=32,
+        prompt_lo=8, prompt_hi=64, new_lo=4, new_hi=32,
+        mean_interarrival_s=0.0005, seed=0,
+    )
+
+
+def default_settings() -> dict:
+    """The capture configuration: big enough that a decode step
+    amortizes host dispatch (the docs/perf.md round-5 lesson), mixed
+    enough that padding waste is realistic.
+    KV budget: rtc_batch 8 x max_seq 320 = 2560 rows = 160 blocks x 16."""
+    return dict(
+        d_model=256, n_layers=4, n_heads=8, n_kv_heads=2, d_ff=1024,
+        vocab_size=4096, max_seq_len=320,
+        num_requests=64, rtc_batch=8,
+        num_slots=12, block_size=16, num_blocks=161,
+        max_request_len=320, prefill_chunk=64,
+        prompt_lo=8, prompt_hi=192, new_lo=4, new_hi=96,
+        mean_interarrival_s=0.005, seed=0,
+    )
+
+
+def build_workload(s: dict):
+    """One shared trace: (rid, prompt, max_new, arrival_offset_s)."""
+    rng = np.random.default_rng(s["seed"])
+    trace = []
+    t = 0.0
+    for i in range(s["num_requests"]):
+        t += float(rng.exponential(s["mean_interarrival_s"]))
+        prompt_len = int(rng.integers(s["prompt_lo"], s["prompt_hi"] + 1))
+        max_new = int(rng.integers(s["new_lo"], s["new_hi"] + 1))
+        prompt = rng.integers(0, s["vocab_size"], prompt_len).astype(np.int32)
+        trace.append((f"req{i}", prompt, max_new, t))
+    return trace
+
+
+def _percentiles(values, ps=(50, 95)):
+    if not values:
+        return {f"p{p}": None for p in ps}
+    return {f"p{p}": float(np.percentile(np.asarray(values), p)) for p in ps}
+
+
+def run_continuous(params, config, s: dict, trace) -> dict:
+    from kubeshare_tpu.serving import EngineConfig, Request, ServingEngine
+
+    engine = ServingEngine(params, config, EngineConfig(
+        num_slots=s["num_slots"], block_size=s["block_size"],
+        num_blocks=s["num_blocks"], max_request_len=s["max_request_len"],
+        prefill_chunk=s["prefill_chunk"]))
+    engine.warmup()
+    compiles_before = engine.compile_counts()
+
+    start = time.monotonic()
+    pending = list(trace)
+    while pending or not engine.idle:
+        now = time.monotonic() - start
+        while pending and pending[0][3] <= now:
+            rid, prompt, max_new, _ = pending.pop(0)
+            engine.submit(Request(rid, prompt, max_new))
+        if not engine.step() and pending:
+            time.sleep(min(0.001, pending[0][3] - now))
+    elapsed = time.monotonic() - start
+
+    recompiles = sum(engine.compile_counts().values()) - sum(
+        compiles_before.values())
+    useful = sum(min(len(engine.result(rid).tokens), max_new)
+                 for rid, _, max_new, _ in trace)
+    ttfts, per_token = [], []
+    for rid, _, max_new, arrival in trace:
+        r = engine.result(rid)
+        ttfts.append((r.first_token_at - start) - arrival)
+        if len(r.tokens) > 1:
+            per_token.append(
+                (r.finished_at - r.first_token_at) / (len(r.tokens) - 1))
+    return {
+        "tokens_per_s": useful / elapsed,
+        "useful_tokens": useful,
+        "elapsed_s": elapsed,
+        "ttft_s": _percentiles(ttfts),
+        "per_token_s": _percentiles(per_token),
+        "decode_steps": engine.decode_steps,
+        "prefill_chunks": engine.prefill_chunks,
+        "kv_hbm_bytes_peak": engine.peak_blocks_in_use
+        * engine.pool.bytes_per_block(),
+        "recompiles": recompiles,
+    }
+
+
+def run_rtc(params, config, s: dict, trace) -> dict:
+    """Run-to-completion baseline: fixed worst-case shapes, batch
+    barrier semantics.  One compiled prefill + one compiled decode scan,
+    both at the workload's max bucket — the shapes a static server must
+    provision (and the KV HBM it must reserve: num_slots x max_seq)."""
+    from kubeshare_tpu.models.decoding import (
+        greedy_decode_with_cache, prefill)
+
+    batch = s["rtc_batch"]
+    p_max = s["prompt_hi"]
+    n_max = s["new_hi"]
+    prefill_fn = jax.jit(lambda w, p: prefill(w, config, p))
+    decode_fn = jax.jit(
+        lambda w, cache, logits: greedy_decode_with_cache(
+            w, config, cache, logits, n_max, prefill_length=p_max))
+    # warmup at the (only) compiled shapes
+    warm = jnp.zeros((batch, p_max), jnp.int32)
+    cache, logits = prefill_fn(params, warm)
+    jax.block_until_ready(decode_fn(params, cache, logits))
+    compiles_before = (prefill_fn._cache_size(), decode_fn._cache_size())
+
+    start = time.monotonic()
+    queue = list(trace)
+    ttfts, finishes = [], []
+    useful = 0
+    while queue:
+        # the server is free: take up to `batch` ARRIVED requests (FIFO;
+        # wait for the first if none has arrived yet)
+        now = time.monotonic() - start
+        if queue[0][3] > now:
+            time.sleep(queue[0][3] - now)
+            now = queue[0][3]
+        group = [queue.pop(0)]
+        while queue and len(group) < batch and queue[0][3] <= now:
+            group.append(queue.pop(0))
+        prompts = np.zeros((batch, p_max), np.int32)
+        for i, (_, prompt, _, _) in enumerate(group):
+            prompts[i, : prompt.size] = prompt  # padded to the max bucket
+        cache, logits = prefill_fn(params, jnp.asarray(prompts))
+        jax.block_until_ready(logits)
+        prefill_done = time.monotonic() - start
+        out = decode_fn(params, cache, logits)
+        jax.block_until_ready(out)
+        batch_done = time.monotonic() - start
+        for rid, _, max_new, arrival in group:
+            # a request's first token exists only once its batch's
+            # prefill completes; it is not DONE until the whole batch
+            # decodes to n_max (run-to-completion's defining cost)
+            ttfts.append(prefill_done - arrival)
+            finishes.append(batch_done - arrival)
+            useful += max_new
+    elapsed = time.monotonic() - start
+
+    recompiles = (prefill_fn._cache_size() + decode_fn._cache_size()
+                  - sum(compiles_before))
+    per_token = [(f - t) / max(1, n_max - 1)
+                 for f, t in zip(finishes, ttfts)]
+    kv_bytes = (2 * config.n_layers * batch * config.kv_heads
+                * config.max_seq_len * config.head_dim
+                * jnp.dtype(config.dtype).itemsize)
+    return {
+        "tokens_per_s": useful / elapsed,
+        "useful_tokens": useful,
+        "elapsed_s": elapsed,
+        "ttft_s": _percentiles(ttfts),
+        "per_token_s": _percentiles(per_token),
+        "kv_hbm_bytes_peak": kv_bytes,
+        "recompiles": recompiles,
+    }
+
+
+def run_bench(s: dict) -> dict:
+    from kubeshare_tpu.models.transformer import (
+        TransformerConfig, transformer_init)
+
+    config = TransformerConfig(
+        vocab_size=s["vocab_size"], d_model=s["d_model"],
+        n_heads=s["n_heads"], n_kv_heads=s["n_kv_heads"],
+        n_layers=s["n_layers"], d_ff=s["d_ff"],
+        max_seq_len=s["max_seq_len"], dtype=jnp.float32,
+        positional="rope", attention="reference")
+    params = transformer_init(jax.random.PRNGKey(s["seed"]), config)
+    # the comparison is KV-HBM-budgeted: both servers cache into the
+    # same number of rows (paging turns the saved worst-case reservation
+    # into extra concurrent slots)
+    pool_rows = (s["num_blocks"] - 1) * s["block_size"]
+    rtc_rows = s["rtc_batch"] * s["max_seq_len"]
+    if pool_rows != rtc_rows:
+        raise ValueError(
+            f"continuous KV budget {pool_rows} rows != run-to-completion "
+            f"budget {rtc_rows} — the equal-HBM comparison the docs "
+            f"claim requires (num_blocks-1)*block_size == "
+            f"rtc_batch*max_seq_len")
+    trace = build_workload(s)
+
+    continuous = run_continuous(params, config, s, trace)
+    rtc = run_rtc(params, config, s, trace)
+    recompiles = continuous.pop("recompiles") + rtc.pop("recompiles")
+    if recompiles:
+        raise RuntimeError(
+            f"{recompiles} recompilations after warmup — a static-shape "
+            f"leak; the comparison (and a TPU serving pod) is invalid")
+    return {
+        "suite": "serving",
+        "metric": "continuous tokens/s over run-to-completion tokens/s "
+                  "(same Poisson mixed-length trace, same KV-HBM budget; "
+                  "useful tokens only)",
+        "settings": {k: v for k, v in s.items()},
+        "continuous": continuous,
+        "run_to_completion": rtc,
+        "ratio": continuous["tokens_per_s"] / rtc["tokens_per_s"],
+        "kv_hbm_ratio": rtc["kv_hbm_bytes_peak"]
+        / max(1, continuous["kv_hbm_bytes_peak"]),
+        "recompiles_after_warmup": recompiles,
+        "platform": jax.default_backend(),
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--smoke", action="store_true",
+                        help="seconds-fast tiny-model CPU path")
+    parser.add_argument("--json", help="write the result JSON here too")
+    args = parser.parse_args()
+    result = run_bench(smoke_settings() if args.smoke else default_settings())
+    text = json.dumps(result, indent=2)
+    print(text)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(text + "\n")
+    ratio = result["ratio"]
+    print(f"\ncontinuous/run-to-completion tokens/s ratio: {ratio:.3f} "
+          f"(target >= 1.5 on the full workload)", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

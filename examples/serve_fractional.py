@@ -1,4 +1,5 @@
-"""Fractional serving walkthrough: token-gated decoding on a shared chip.
+"""Fractional serving walkthrough: the continuous-batching engine on a
+token-gated shared chip.
 
 The serving twin of demo_e2e's training story (the reference shared GPUs
 only for training pods — serving on a fraction of a chip is a capability
@@ -6,20 +7,25 @@ this framework adds):
 
   - a GQA Transformer (the KV cache, decode's dominant HBM cost, shrinks
     by the query-head group factor)
-  - chunked prefill (`prefill_chunked`): MXU-shaped [b, chunk, d] steps
-    with O(chunk) activation memory, not token-at-a-time slivers
-  - greedy decode continuing from the prefilled cache
+  - a block-paged KV cache (`serving/kv_blocks.py`): HBM reserved per
+    request actually admitted, not `max_seq_len` per slot
+  - the continuous-batching engine (`serving/engine.py`): mixed-length
+    requests queue through a static slot pool — admitted mid-flight into
+    freed slots, chunked prefill interleaved with batched decode spans,
+    retired on max-tokens with their blocks recycled — zero
+    recompilation after warmup
   - every XLA dispatch gated through the native token runtime exactly as
     a 0.5-chip pod's would be: tpushare-tokend (real C++ binary) grants
     budgeted time-quota tokens, the ExecutionGuard charges measured step
-    time back
+    time back (the engine charges EVERY prefill chunk and decode span)
 
 Run (no TPU needed; the chip is CPU here, the runtime is real):
 
     JAX_PLATFORMS=cpu python -m examples.serve_fractional
 
-`bench.py --suite serve` measures the same shape under co-tenancy (two
-decode pods at 0.5 chip each vs solo, p50/p95 request latency).
+`bench.py --suite serve` measures co-tenancy (two decode pods at 0.5
+chip each vs solo); `benchmarks/serving_bench.py` measures continuous
+batching vs the run-to-completion baseline this example used to drive.
 """
 
 from __future__ import annotations
@@ -44,11 +50,10 @@ import numpy as np
 
 def main() -> None:
     from kubeshare_tpu.isolation import ExecutionGuard, TokenClient
-    from kubeshare_tpu.models.decoding import (
-        greedy_decode_with_cache, prefill_chunked)
     from kubeshare_tpu.models.transformer import (
         TransformerConfig, transformer_init)
     from kubeshare_tpu.runtime import find_binary
+    from kubeshare_tpu.serving import EngineConfig, Request, ServingEngine
     from kubeshare_tpu.utils.atomicfile import write_atomic
 
     tokend = find_binary("tpushare-tokend")
@@ -64,11 +69,19 @@ def main() -> None:
         vocab_size=8000, max_seq_len=256, dtype=jnp.float32,
         positional="rope", attention="reference")
     params = transformer_init(jax.random.PRNGKey(0), config)
-    cache_bytes = (2 * config.n_layers * 2 * config.kv_heads
-                   * config.max_seq_len * config.head_dim * 4)
-    mha_bytes = cache_bytes * config.n_heads // config.kv_heads
-    print(f"KV cache (batch 2): {cache_bytes / 1e6:.1f} MB "
-          f"(MHA would be {mha_bytes / 1e6:.1f} MB)")
+    engine_config = EngineConfig(
+        num_slots=4, block_size=16, num_blocks=33,  # 32 blocks = 512 rows
+        max_request_len=192, prefill_chunk=32, decode_span=4)
+    dense_bytes = (2 * config.n_layers * engine_config.num_slots
+                   * config.kv_heads * config.max_seq_len
+                   * config.head_dim * 4)
+    paged_bytes = ((engine_config.num_blocks - 1)
+                   * 2 * config.n_layers * config.kv_heads
+                   * engine_config.block_size * config.head_dim * 4)
+    print(f"KV pool: {paged_bytes / 1e6:.1f} MB in "
+          f"{engine_config.num_blocks - 1} blocks (dense caches for "
+          f"{engine_config.num_slots} slots would pin "
+          f"{dense_bytes / 1e6:.1f} MB)")
 
     print("=== 2. runtime: tokend with a 0.5-share serving pod ===")
     workdir = tempfile.mkdtemp(prefix="serve-demo-")
@@ -98,40 +111,48 @@ def main() -> None:
     try:
         client = TokenClient("127.0.0.1", port, "demo/serve-pod")
         guard = ExecutionGuard(client=client, from_env=False)
+        engine = ServingEngine(params, config, engine_config, guard=guard)
 
-        print("=== 3. requests: chunked prefill + gated decode ===")
+        print("=== 3. compile once, serve any mix (zero recompiles) ===")
+        # warm the jit caches OUTSIDE the gated window, like the
+        # training pods warm their step
+        engine.warmup()
+        warm_counts = engine.compile_counts()
+        print(f"compiled steps: {warm_counts}")
+
+        print("=== 4. requests: 8 mixed-length prompts through 4 slots ===")
         rng = np.random.default_rng(0)
-        prompts = jnp.asarray(
-            rng.integers(0, config.vocab_size, (3, 2, 64)), jnp.int32)
-
-        # the serving split: prefill once (chunked), decode FROM its cache.
-        # params ride as jit ARGUMENTS — closing over them would bake the
-        # weights in as XLA constants (slow compiles, duplicated memory)
-        prefill_fn = jax.jit(
-            lambda w, p: prefill_chunked(w, config, p, chunk=32))
-        # prefill_length is STATIC under jit: it lets the decode validate
-        # prompt+new tokens against cache capacity at trace time (the
-        # traced cache length can't be checked then)
-        decode_fn = jax.jit(
-            lambda w, cache, logits: greedy_decode_with_cache(
-                w, config, cache, logits, 32, prefill_length=64))
-        # warm the compile caches outside the gated window
-        warm_cache, warm_logits = prefill_fn(params, prompts[0])
-        jax.block_until_ready(decode_fn(params, warm_cache, warm_logits))
-
-        for i, prompt in enumerate(prompts):
-            start = time.monotonic()
-            guard.acquire()
-            gated = time.monotonic()
-            cache, first_logits = prefill_fn(params, prompt)
-            out = decode_fn(params, cache, first_logits)
-            jax.block_until_ready(out)
-            done = time.monotonic()
-            guard.charge((done - gated) * 1e3)
-            print(f"request {i}: queue {1e3 * (gated - start):.1f} ms, "
-                  f"service {1e3 * (done - gated):.1f} ms, "
-                  f"{out.shape[1]} new tokens x {out.shape[0]} rows")
-        guard.finish()
+        requests = []
+        for i in range(8):
+            prompt_len = int(rng.integers(12, 97))
+            max_new = int(rng.integers(8, 49))
+            requests.append(Request(
+                f"req{i}", rng.integers(0, config.vocab_size, prompt_len),
+                max_new))
+            engine.submit(requests[-1])
+        start = time.monotonic()
+        results = engine.run()
+        elapsed = time.monotonic() - start
+        total = 0
+        for req in requests:
+            r = results[req.rid]
+            total += len(r.tokens)
+            print(f"{req.rid}: prompt {r.prompt_len:3d} -> "
+                  f"{len(r.tokens):2d} tokens, "
+                  f"ttft {1e3 * r.ttft:6.1f} ms, "
+                  f"done +{1e3 * (r.finished_at - r.submitted_at):6.1f} ms")
+        end_counts = engine.compile_counts()
+        recompiles = sum(end_counts.values()) - sum(warm_counts.values())
+        print(f"aggregate: {total} tokens in {elapsed:.2f} s "
+              f"({total / elapsed:.0f} tok/s); "
+              f"peak blocks {engine.peak_blocks_in_use}/"
+              f"{engine.allocator.num_blocks - 1}; "
+              f"recompiles after warmup: {recompiles} "
+              f"({end_counts} vs {warm_counts})")
+        if recompiles:
+            raise RuntimeError(
+                f"{recompiles} recompilations after warmup — static-shape "
+                f"leak in the serving steps")
 
         import json
 
@@ -139,7 +160,8 @@ def main() -> None:
         pod = stat["pods"]["demo/serve-pod"]
         print(f"tokend accounting: grants={pod['grants']} "
               f"charged={pod['charged_total_ms']:.0f} ms "
-              f"(share limit 1.0, request 0.5)")
+              f"(share limit 1.0, request 0.5) — every prefill chunk and "
+              f"decode span charged through the guard")
         print("serve demo complete")
     finally:
         proc.kill()

@@ -20,6 +20,9 @@ Layout (see SURVEY.md for the reference layer map this mirrors):
 - ``models/ops/parallel``  TPU workload library (JAX/pjit/pallas) — the
   compute path the framework schedules; absent in the reference (it schedules
   external PyTorch workloads) but first-class here.
+- ``serving``    continuous-batching inference engine over a block-paged
+  KV cache — static-shape slot pool, mid-flight admission, token-gated
+  dispatch; the serving-side twin of the training workload library.
 """
 
 __version__ = "0.1.0"

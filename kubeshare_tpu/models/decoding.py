@@ -94,10 +94,14 @@ def init_kv_cache(config: TransformerConfig, batch: int) -> Dict:
 def _attend_cached(q, cache_k, cache_v, q_positions, window=None):
     """q: [b,h,Cq,d] against cache [b,h_kv,S,d]; per-query causal band.
 
-    ``q_positions`` [Cq] are the queries' global positions: query i sees
+    ``q_positions`` are the queries' global positions: query i sees
     cache slots ``k_pos <= q_positions[i]`` (and, with a window, within
     ``q_pos - k_pos < window`` — the same band transformer_apply's dense
     mask keeps).  Cq = 1 is the decode step; Cq > 1 is a prefill chunk.
+    Shape [Cq] shares positions across the batch (the dense cache, whose
+    rows advance in lockstep); shape [b, Cq] gives every batch row its
+    OWN positions — the paged serving pool, where each slot sits at its
+    own length (serving/paged.py).
 
     GQA: when h > h_kv the query heads are grouped over the shared KV
     heads ([b, h_kv, g, Cq, d] x [b, h_kv, S, d]) — no KV repetition is
@@ -111,17 +115,26 @@ def _attend_cached(q, cache_k, cache_v, q_positions, window=None):
     scores = jnp.einsum(
         "bhgqd,bhkd->bhgqk", qg, cache_k).astype(jnp.float32) * scale
     k_pos = jnp.arange(cache_k.shape[2])
-    valid = k_pos[None, :] <= q_positions[:, None]  # [Cq, S]
-    if window is not None:
-        valid = valid & (q_positions[:, None] - k_pos[None, :] < window)
-    scores = jnp.where(valid[None, None, None], scores, -jnp.inf)
+    if q_positions.ndim == 1:
+        valid = k_pos[None, :] <= q_positions[:, None]  # [Cq, S]
+        if window is not None:
+            valid = valid & (q_positions[:, None] - k_pos[None, :] < window)
+        valid = valid[None, None, None]  # -> [1,1,1,Cq,S]
+    else:
+        valid = k_pos[None, None, :] <= q_positions[:, :, None]  # [b, Cq, S]
+        if window is not None:
+            valid = valid & (
+                q_positions[:, :, None] - k_pos[None, None, :] < window)
+        valid = valid[:, None, None]  # -> [b,1,1,Cq,S]
+    scores = jnp.where(valid, scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1).astype(cache_v.dtype)
     out = jnp.einsum("bhgqk,bhkd->bhgqd", probs, cache_v)
     return out.reshape(b, h, cq, d)
 
 
 def _decode_chunk(params, config: TransformerConfig, cache: Dict,
-                  tokens: jax.Array, head_last_only: bool = False):
+                  tokens: jax.Array, head_last_only: bool = False,
+                  head_row: Optional[int] = None):
     """A width-C cached step: tokens [batch, C] at positions
     ``length .. length+C-1`` -> (logits [batch, C, vocab], cache).
 
@@ -133,7 +146,9 @@ def _decode_chunk(params, config: TransformerConfig, cache: Dict,
     ``head_last_only``: project lm_head over the final position only
     (logits [batch, 1, vocab]) — prefill needs just the last row, and a
     full [batch, C, vocab] f32 buffer would otherwise dominate the
-    chunked step's activations at real vocab sizes."""
+    chunked step's activations at real vocab sizes.  ``head_row``
+    selects a single OTHER row instead (the pad-forward ragged prefill,
+    whose last real token is not the chunk's last row)."""
     dtype = config.dtype
     position = cache["length"]
     chunk = tokens.shape[1]
@@ -191,7 +206,12 @@ def _decode_chunk(params, config: TransformerConfig, cache: Dict,
             x = x + y @ layer["mlp"]["w_out"].astype(dtype)
 
     x = _rms_norm(x, params["final_norm"]["scale"])
-    head_in = x[:, -1:] if head_last_only else x
+    if head_last_only:
+        head_in = x[:, -1:]
+    elif head_row is not None:
+        head_in = x[:, head_row: head_row + 1]
+    else:
+        head_in = x
     logits = (head_in @ params["lm_head"].astype(dtype)).astype(jnp.float32)
     cache = {
         "k": jnp.stack(new_k),
@@ -244,6 +264,20 @@ def prefill(params, config: TransformerConfig, prompt: jax.Array) -> Tuple[Dict,
     return cache, last_logits
 
 
+def bucket_width(remainder: int, chunk: int) -> int:
+    """The power-of-two chunk width covering ``remainder`` tokens
+    (capped at ``chunk``).  Bucketing the ragged final chunk bounds a
+    serving host's compiled prefill shapes at O(log chunk) instead of
+    one per distinct remainder — the serving engine's prefill planner
+    uses the same buckets (serving/engine.py)."""
+    if not 0 < remainder <= chunk:
+        raise ValueError(f"remainder {remainder} not in 1..{chunk}")
+    width = 1
+    while width < remainder:
+        width *= 2
+    return min(width, chunk)
+
+
 def prefill_chunked(
     params, config: TransformerConfig, prompt: jax.Array, chunk: int,
 ) -> Tuple[Dict, jax.Array]:
@@ -251,28 +285,61 @@ def prefill_chunked(
     (:func:`_decode_chunk`), so peak activation memory is O(chunk)
     instead of the bulk path's O(prompt_len) — the long-prompt regime —
     while every chunk still runs MXU-shaped [b, chunk, d] matmuls
-    rather than the incremental path's [b, 1, d] slivers.  The prompt
-    length must tile ``chunk`` (pad the prompt, or pick a divisor)."""
+    rather than the incremental path's [b, 1, d] slivers.
+
+    Ragged prompts are allowed: the tail past the last full chunk runs
+    as ONE extra chunk of the next power-of-two width (``bucket_width``),
+    sliding its start BACK over already-written positions — recomputing
+    identical K/V, so the overwrite is a no-op — so that its last row is
+    the prompt's last real token.  A prompt shorter than its own bucket
+    pads forward instead; its dead rows are zeroed and the returned
+    logits taken at the last real row, keeping the cache and logits
+    bit-equal to the bulk prefill's.  Distinct remainders therefore cost
+    at most O(log chunk) compiled chunk shapes, not one each."""
     batch, prompt_len = prompt.shape
     _check_prompt_fits(config, prompt_len)
     _check_moe_decodable(config)
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
-    if prompt_len % chunk != 0:
-        raise ValueError(
-            f"prompt length {prompt_len} does not tile chunk {chunk}; pad "
-            "the prompt or pick a divisor"
-        )
     cache = init_kv_cache(config, batch)
+    n_full, remainder = divmod(prompt_len, chunk)
+    last_logits = None
 
-    def step(cache, chunk_tokens):
-        logits, cache = _decode_chunk(params, config, cache,
-                                      chunk_tokens.T, head_last_only=True)
-        return cache, logits[:, 0]
+    if n_full:
+        def step(cache, chunk_tokens):
+            logits, cache = _decode_chunk(params, config, cache,
+                                          chunk_tokens.T, head_last_only=True)
+            return cache, logits[:, 0]
 
-    chunks = prompt.T.reshape(prompt_len // chunk, chunk, batch)
-    cache, last_logits = jax.lax.scan(step, cache, chunks)
-    return cache, last_logits[-1]
+        chunks = prompt[:, : n_full * chunk].T.reshape(n_full, chunk, batch)
+        cache, scan_logits = jax.lax.scan(step, cache, chunks)
+        last_logits = scan_logits[-1]
+    if remainder == 0:
+        return cache, last_logits
+
+    # cap at the cache bound: a short model (max_seq_len below the
+    # bucket) must not pad past its own cache (the cap can only bind in
+    # the pad-forward branch, where prompt_len <= max_seq_len < width)
+    width = min(bucket_width(remainder, chunk), config.max_seq_len)
+    if prompt_len >= width:
+        # slide the final chunk back so it ENDS at the last real token
+        tail = prompt[:, prompt_len - width:]
+        cache = dict(cache, length=jnp.asarray(prompt_len - width, jnp.int32))
+        tail_logits, cache = _decode_chunk(params, config, cache, tail,
+                                           head_last_only=True)
+        return cache, tail_logits[:, 0]
+
+    # n_full == 0 and the bucket overshoots the prompt: pad the tail.
+    # The pad rows' outputs are discarded and their K/V zeroed below, so
+    # the returned cache matches the bulk prefill's exactly (decode from
+    # it is bit-identical).
+    padded = jnp.pad(prompt, ((0, 0), (0, width - prompt_len)))
+    row_logits, cache = _decode_chunk(params, config, cache, padded,
+                                      head_row=prompt_len - 1)
+    cache["k"] = cache["k"].at[:, :, :, prompt_len:width, :].set(0)
+    cache["v"] = cache["v"].at[:, :, :, prompt_len:width, :].set(0)
+    cache = dict(cache, length=jnp.asarray(prompt_len, jnp.int32))
+    return cache, row_logits[:, 0]
 
 
 def prefill_incremental(

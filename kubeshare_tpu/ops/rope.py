@@ -24,15 +24,23 @@ def apply_rope(
     positions: jax.Array,
     theta: float = 10000.0,
 ) -> jax.Array:
-    """Rotate [batch, heads, seq, head_dim] by per-token positions [seq].
+    """Rotate [batch, heads, seq, head_dim] by per-token positions.
 
-    Split-half convention: pairs (x[..., :d/2], x[..., d/2:]).
+    ``positions`` [seq] shares positions across the batch; [batch, seq]
+    rotates every batch row by its OWN positions — the paged serving
+    pool, where each slot sits at its own decode length
+    (serving/paged.py).  Split-half convention: pairs
+    (x[..., :d/2], x[..., d/2:]).
     """
     d = x.shape[-1]
     inv_freq = rope_frequencies(d, theta)
-    angles = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]  # [s, d/2]
-    cos = jnp.cos(angles)[None, None, :, :]
-    sin = jnp.sin(angles)[None, None, :, :]
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [(b,)s, d/2]
+    if positions.ndim == 1:
+        cos = jnp.cos(angles)[None, None, :, :]
+        sin = jnp.sin(angles)[None, None, :, :]
+    else:
+        cos = jnp.cos(angles)[:, None, :, :]  # [b, 1, s, d/2]
+        sin = jnp.sin(angles)[:, None, :, :]
     x1 = x[..., : d // 2].astype(jnp.float32)
     x2 = x[..., d // 2 :].astype(jnp.float32)
     rotated = jnp.concatenate(

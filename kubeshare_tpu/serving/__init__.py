@@ -1,0 +1,37 @@
+"""Continuous-batching serving engine over a block-paged KV cache.
+
+The serving subsystem the fractional-chip runtime was built to host:
+
+- :mod:`kv_blocks` — a fixed-size-block KV pool with a free-list
+  allocator (the cell allocator's reserve/reclaim discipline applied to
+  HBM), so cache memory is charged per token actually generated instead
+  of per ``max_seq_len`` slot;
+- :mod:`paged` — the paged twins of the dense cached model steps
+  (``models/decoding._decode_chunk``): chunked prefill writing straight
+  into a slot's blocks, and a batched decode step where every slot sits
+  at its OWN length;
+- :mod:`engine` — the continuous-batching engine: one jitted step over a
+  static pool of S slots with an active mask, admitting queued requests
+  into freed slots mid-flight, interleaving chunked prefill with batched
+  decode, retiring slots on EOS/max-tokens and recycling their blocks —
+  zero recompilation after warmup, every dispatch chargeable through the
+  :class:`~kubeshare_tpu.isolation.ExecutionGuard` token path.
+"""
+
+from .engine import EngineConfig, Request, RequestResult, ServingEngine
+from .kv_blocks import BlockExhausted, BlockAllocator, PagedKVPool, init_paged_pool
+from .paged import paged_decode_step, paged_gather_kv, paged_prefill_step
+
+__all__ = [
+    "BlockAllocator",
+    "BlockExhausted",
+    "EngineConfig",
+    "PagedKVPool",
+    "Request",
+    "RequestResult",
+    "ServingEngine",
+    "init_paged_pool",
+    "paged_decode_step",
+    "paged_gather_kv",
+    "paged_prefill_step",
+]

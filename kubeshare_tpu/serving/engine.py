@@ -1,0 +1,570 @@
+"""Continuous-batching serving engine over the paged KV pool.
+
+The run-to-completion serving path (one fixed batch prefills, decodes to
+a uniform length, then the next batch starts) wastes the chip twice:
+short requests wait on the batch's longest, and every batch row reserves
+``max_seq_len`` of cache whether it needs it or not.  This engine
+schedules at TOKEN granularity instead:
+
+- a static pool of S slots runs ONE jitted decode step per iteration —
+  every active slot advances a token, each at its own length (the paged
+  step's per-row positions);
+- queued requests are admitted into freed slots MID-FLIGHT — admission
+  reserves exactly the blocks the request can ever touch
+  (prompt + max_new_tokens, rounded to blocks), and a reservation the
+  pool cannot fund queues the request rather than clamping anything;
+- prompts prefill in fixed-width chunks (widths bucketed to powers of
+  two, so ragged prompts hit O(log chunk) compiled shapes, not one per
+  remainder), scheduled ahead of decode (the Orca discipline — a fuller
+  slot pool makes every static-width decode step denser, and TTFT is
+  bounded by chunks, not batch barriers);
+- decode advances every active slot ``decode_span`` tokens per dispatch
+  (a lax.scan of step-identical iterations; lanes self-deactivate on
+  budget/EOS) — dispatch overhead amortized the way the PyGraph line of
+  work batches GPU launches;
+- slots retire on EOS / max-tokens; their blocks go back to the
+  free list and the next queued request takes them over.
+
+Everything device-side is static-shaped — slot count, block tables,
+chunk widths — so after one warmup pass NOTHING recompiles
+(``compile_counts`` exposes the jit cache sizes; the zero-recompile
+property is test- and bench-asserted).
+
+Fractional-chip integration: every device dispatch (prefill chunk with
+its fused first-token pick, decode span) charges through an
+:class:`~kubeshare_tpu.isolation.ExecutionGuard` when one is given, so a
+0.5-chip serving pod's engine is gated exactly like the run-to-
+completion path it replaces (examples/serve_fractional.py).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field, replace
+from typing import Deque, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.decoding import _filter_logits, bucket_width
+from ..models.transformer import TransformerConfig
+from .kv_blocks import BlockAllocator, BlockExhausted, init_paged_pool
+from .paged import paged_decode_step, paged_prefill_step
+
+
+def plan_prefill_chunks(
+    prompt_len: int, chunk: int, max_len: int
+) -> Tuple[List[Tuple[int, int, int]], int]:
+    """Split a prompt into (start, width, last_row) chunks of bucketed
+    widths; returns (plan, cover) where ``cover`` is the highest cache
+    row the plan writes + 1 (never past ``max_len``, the slot's row
+    bound — a short pool must not pad past the rows a request may own).
+
+    Full-width chunks tile the prompt's prefix; the ragged tail becomes
+    ONE bucketed chunk that ENDS exactly at the prompt's last token by
+    sliding its start back over already-written positions (recomputing
+    identical K/V — deterministic, so overwrite == no-op).  Only a
+    prompt shorter than its own bucket pads forward; its pad rows are
+    dead (outputs discarded, K/V overwritten by decode's write-then-
+    attend order before any causal band reaches them).
+    """
+    n, r = divmod(prompt_len, chunk)
+    plan = [(i * chunk, chunk, chunk - 1) for i in range(n)]
+    cover = n * chunk
+    if r:
+        width = min(bucket_width(r, chunk), max_len)
+        if prompt_len >= width:
+            plan.append((prompt_len - width, width, width - 1))
+            cover = prompt_len
+        else:  # n == 0: pad the tail; logits row is the last REAL token
+            plan = [(0, width, prompt_len - 1)]
+            cover = width
+    return plan, cover
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Static serving-pool geometry.  ``num_slots`` bounds in-flight
+    requests; ``num_blocks``/``block_size`` size the KV pool
+    (HBM = num_blocks x bytes_per_block, sizing guidance in
+    docs/perf.md); ``max_request_len`` bounds prompt + generation per
+    request and fixes the block-table width."""
+
+    num_slots: int = 8
+    block_size: int = 16
+    num_blocks: int = 129  # 128 allocatable + scratch block 0
+    max_request_len: int = 256
+    prefill_chunk: int = 32
+    # decode steps fused into ONE dispatch (a lax.scan inside the jitted
+    # step): amortizes per-step dispatch/launch overhead the way the
+    # PyGraph line of work does for GPU graphs — the decode math is
+    # step-identical, lanes self-deactivate mid-span on budget/EOS, so
+    # equivalence survives any span.  1 = dispatch per token.
+    decode_span: int = 4
+    eos_token: Optional[int] = None
+    # sampling restriction set, engine-wide (temperature rides per
+    # request; the filter set is part of the compiled step)
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+
+
+@dataclass
+class Request:
+    """One generation request.  ``temperature == 0`` is greedy;
+    sampled requests must carry their own PRNG ``rng`` (the engine
+    consumes keys exactly like ``sample_decode_with_cache``, so a
+    single-slot engine reproduces it bit-for-bit)."""
+
+    rid: str
+    prompt: np.ndarray
+    max_new_tokens: int
+    temperature: float = 0.0
+    rng: Optional[jax.Array] = None
+
+
+@dataclass
+class RequestResult:
+    rid: str
+    prompt_len: int
+    tokens: List[int] = field(default_factory=list)
+    submitted_at: float = 0.0
+    admitted_at: Optional[float] = None
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+
+    @property
+    def done(self) -> bool:
+        return self.finished_at is not None
+
+    @property
+    def ttft(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+
+class _Slot:
+    __slots__ = (
+        "idx", "state", "rid", "blocks", "table", "length", "generated",
+        "prompt", "plan", "max_new", "temperature", "first_key",
+        "step_keys", "result",
+    )
+
+    def __init__(self, idx: int, table_width: int) -> None:
+        self.idx = idx
+        self.state = "free"  # free | prefill | decode
+        self.table = np.zeros(table_width, np.int32)
+        self._clear()
+
+    def _clear(self) -> None:
+        self.rid = ""
+        self.blocks: List[int] = []
+        self.table[:] = 0  # every entry back to the scratch block
+        self.length = 0
+        self.generated: List[int] = []
+        self.prompt = None
+        self.plan: List[Tuple[int, int, int]] = []
+        self.max_new = 0
+        self.temperature = 0.0
+        self.first_key = None
+        self.step_keys = None
+        self.result: Optional[RequestResult] = None
+
+
+class ServingEngine:
+    """Continuous-batching engine; see module docstring.
+
+    Drive it with :meth:`submit` + :meth:`run` (drain everything) or
+    :meth:`step` (one scheduling iteration — what a serving loop with
+    live arrivals calls)."""
+
+    def __init__(
+        self,
+        params,
+        config: TransformerConfig,
+        engine_config: Optional[EngineConfig] = None,
+        guard=None,
+    ) -> None:
+        ec = engine_config or EngineConfig()
+        if ec.max_request_len > config.max_seq_len:
+            raise ValueError(
+                f"max_request_len {ec.max_request_len} exceeds the model's "
+                f"max_seq_len {config.max_seq_len}"
+            )
+        if ec.prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got {ec.prefill_chunk}")
+        if ec.decode_span < 1:
+            raise ValueError(f"decode_span must be >= 1, got {ec.decode_span}")
+        # fail fast on a bad filter set, like the dense sampling entries
+        _filter_logits(jnp.zeros((1, 2)), ec.top_k, ec.top_p)
+        self.params = params
+        self.model_config = config
+        self.engine_config = ec
+        self.guard = guard
+        self.pool = init_paged_pool(config, ec.num_blocks, ec.block_size)
+        self.allocator = BlockAllocator(ec.num_blocks, ec.block_size)
+        self._table_width = -(-ec.max_request_len // ec.block_size)
+        self._slots = [_Slot(i, self._table_width)
+                       for i in range(ec.num_slots)]
+        # (request, prefill plan, blocks needed) — computed once at submit
+        self._queue: Deque[Tuple[Request, List[Tuple[int, int, int]], int]] = deque()
+        self._results: Dict[str, RequestResult] = {}
+        # counters (the bench's raw material)
+        self.decode_steps = 0
+        self.prefill_chunks = 0
+        self.tokens_generated = 0
+        self.peak_blocks_in_use = 0
+
+        cfg = config
+        top_k, top_p = ec.top_k, ec.top_p
+
+        def pick_rows(logits, temps, keys):
+            # greedy rows take the argmax; sampled rows follow the dense
+            # serving split's exact order (temperature scale, then the
+            # k/nucleus restriction, then categorical) so a single-slot
+            # engine reproduces sample_decode_with_cache's stream
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            safe_t = jnp.where(temps > 0, temps, 1.0)
+            filtered = _filter_logits(logits / safe_t[:, None], top_k, top_p)
+            sampled = jax.vmap(jax.random.categorical)(keys, filtered)
+            return jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+
+        # params ride as jit ARGUMENTS — closing over them would bake the
+        # weights in as XLA constants (slow compiles, duplicated memory).
+        # The prefill step serves every same-width waiting slot in ONE
+        # dispatch and fuses the first-token pick (only lanes finishing
+        # their prompt consume it), so a finished prefill costs no extra
+        # dispatch for its first token.
+        def prefill(w, pk, pv, tables, starts, active, tokens, last_rows,
+                    temps, keys):
+            logits, pk, pv = paged_prefill_step(
+                w, cfg, pk, pv, tables, starts, active, tokens, last_rows)
+            return pick_rows(logits, temps, keys), pk, pv
+
+        # the pool buffers are DONATED: each step updates the cache in
+        # place device-side instead of materializing a second pool (on a
+        # fractional-HBM pod a transient 2x cache would blow the cap)
+        self._prefill_step = jax.jit(prefill, donate_argnums=(1, 2))
+
+        span = ec.decode_span
+        eos = ec.eos_token
+
+        def decode(w, pk, pv, tables, lengths, active, tokens, temps,
+                   keys, budgets):
+            # ONE dispatch advances every lane up to `span` tokens: the
+            # scan body is EXACTLY the single step, so the emitted math
+            # is span-invariant; a lane whose request finishes mid-span
+            # (budget spent, or EOS sampled) deactivates itself — its
+            # remaining iterations write to the scratch block and its
+            # surplus emissions are ignored host-side.
+            def body(carry, i):
+                pk, pv, lengths, toks, alive = carry
+                logits, pk, pv = paged_decode_step(
+                    w, cfg, pk, pv, tables, lengths, alive, toks)
+                nxt = pick_rows(logits, temps, keys[:, i])
+                lengths = lengths + alive.astype(jnp.int32)
+                cont = alive & (i + 1 < budgets)
+                if eos is not None:
+                    cont = cont & (nxt != eos)
+                return (pk, pv, lengths, nxt, cont), nxt
+
+            carry = (pk, pv, lengths, tokens, active)
+            (pk, pv, _, _, _), emitted = jax.lax.scan(
+                body, carry, jnp.arange(span))
+            return emitted, pk, pv  # emitted [span, S]
+
+        self._decode_step = jax.jit(decode, donate_argnums=(1, 2))
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def submit(self, request: Request) -> RequestResult:
+        """Queue a request; validation failures raise HERE (loudly), a
+        merely-busy pool queues."""
+        prompt = np.asarray(request.prompt, np.int32)
+        if prompt.ndim != 1 or prompt.size < 1:
+            raise ValueError(f"prompt must be a non-empty 1-D token array, "
+                             f"got shape {prompt.shape}")
+        if request.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {request.max_new_tokens}")
+        if request.temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0, got {request.temperature}")
+        if request.temperature > 0.0 and request.rng is None:
+            raise ValueError("sampled requests (temperature > 0) must carry rng")
+        if request.rid in self._results and not self._results[request.rid].done:
+            raise ValueError(f"request id {request.rid!r} already in flight")
+        ec = self.engine_config
+        plan, cover = plan_prefill_chunks(
+            prompt.size, ec.prefill_chunk, ec.max_request_len)
+        total_rows = max(cover, prompt.size + request.max_new_tokens)
+        if total_rows > ec.max_request_len:
+            raise ValueError(
+                f"request {request.rid!r}: prompt {prompt.size} + "
+                f"max_new_tokens {request.max_new_tokens} needs "
+                f"{total_rows} cache rows, over max_request_len "
+                f"{ec.max_request_len}"
+            )
+        needed = self.allocator.blocks_for_tokens(total_rows)
+        if needed > self.allocator.num_blocks - 1:
+            raise BlockExhausted(
+                f"request {request.rid!r} needs {needed} blocks but the "
+                f"pool only has {self.allocator.num_blocks - 1} — it can "
+                f"NEVER be admitted (grow num_blocks or shrink the request)"
+            )
+        result = RequestResult(rid=request.rid, prompt_len=prompt.size,
+                               submitted_at=time.monotonic())
+        self._results[request.rid] = result
+        # the plan and block count ride with the queued request — _admit
+        # must not redo this work on every scheduling tick
+        self._queue.append((replace(request, prompt=prompt), plan, needed))
+        return result
+
+    def step(self) -> bool:
+        """One scheduling iteration: admit what fits, then run one
+        prefill chunk or one batched decode span.  Prefill has priority
+        (the Orca discipline): an empty slot earns nothing until its
+        prompt is cached, so filling slots first maximizes the width of
+        every subsequent decode step — and it is what bounds TTFT.
+        Decode lanes are static-shaped, so a fuller pool is pure win.
+        Returns False when the engine is fully idle."""
+        self._admit()
+        prefill = [s for s in self._slots if s.state == "prefill"]
+        decode = [s for s in self._slots if s.state == "decode"]
+        if prefill:
+            self._run_prefill_chunk(prefill[0])
+            return True
+        if decode:
+            self._run_decode_step(decode)
+            return True
+        return False
+
+    def run(self) -> Dict[str, RequestResult]:
+        """Drain the queue and every in-flight slot; returns results by
+        request id."""
+        try:
+            while self.step():
+                pass
+        finally:
+            if self.guard is not None:
+                self.guard.finish()
+        return dict(self._results)
+
+    @property
+    def idle(self) -> bool:
+        return not self._queue and all(s.state == "free" for s in self._slots)
+
+    def result(self, rid: str) -> RequestResult:
+        return self._results[rid]
+
+    def pop_finished(self) -> Dict[str, RequestResult]:
+        """Remove and return every completed result — the live-loop
+        caller's eviction point.  A server driving :meth:`step` forever
+        must drain results here, or the result map (each with its full
+        token list) grows with every request ever served; the
+        :meth:`run` drain pattern reads its returned snapshot instead."""
+        done = {rid: r for rid, r in self._results.items() if r.done}
+        for rid in done:
+            del self._results[rid]
+        return done
+
+    def warmup(self) -> None:
+        """Compile every step the engine can ever dispatch: the decode
+        step and one prefill chunk per bucketed width.  After this, a
+        workload of any shape runs with ZERO recompilation
+        (compile_counts stays fixed — test- and bench-asserted)."""
+        ec = self.engine_config
+        widths = {ec.prefill_chunk}
+        w = 1
+        while w < ec.prefill_chunk:
+            widths.add(w)
+            w *= 2
+        # the pad-forward bucket is capped at the slot row bound, so a
+        # short pool folds the over-wide buckets into one (possibly
+        # non-power-of-two) max_request_len-wide shape
+        widths = {min(w, ec.max_request_len) for w in widths}
+        s = ec.num_slots
+        one = jnp.zeros((1,), jnp.int32)
+        for width in sorted(widths):
+            # the pool rides through every warmup call (its buffers are
+            # donated); the only writes land in the scratch block
+            _, pk, pv = self._prefill_step(
+                self.params, self.pool.k, self.pool.v,
+                jnp.zeros((1, self._table_width), jnp.int32),
+                one, jnp.zeros((1,), bool),
+                jnp.zeros((1, width), jnp.int32), one,
+                jnp.zeros((1,), jnp.float32),
+                jnp.zeros((1, 2), jnp.uint32))
+            self.pool = replace(self.pool, k=pk, v=pv)
+        zeros_s = jnp.zeros((s,), jnp.int32)
+        _, pk, pv = self._decode_step(
+            self.params, self.pool.k, self.pool.v,
+            jnp.zeros((s, self._table_width), jnp.int32),
+            zeros_s, jnp.zeros((s,), bool), zeros_s,
+            jnp.zeros((s,), jnp.float32),
+            jnp.zeros((s, ec.decode_span, 2), jnp.uint32), zeros_s)
+        self.pool = replace(self.pool, k=pk, v=pv)
+        jax.block_until_ready(pk)
+
+    def compile_counts(self) -> Dict[str, int]:
+        """Jit cache sizes per step function — the zero-recompile
+        assertion's raw data."""
+        return {
+            "decode": self._decode_step._cache_size(),
+            "prefill": self._prefill_step._cache_size(),
+        }
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+    def _admit(self) -> None:
+        """FIFO admission: pop queued requests into free slots while the
+        allocator can fund them.  Head-of-line blocking is deliberate —
+        skipping ahead would starve large requests forever."""
+        while self._queue:
+            free = [s for s in self._slots if s.state == "free"]
+            if not free:
+                return
+            req, plan, needed = self._queue[0]
+            try:
+                blocks = self.allocator.reserve(needed, req.rid)
+            except BlockExhausted:
+                return  # stays queued; retirement will free blocks
+            self._queue.popleft()
+            slot = free[0]
+            slot.state = "prefill"
+            slot.rid = req.rid
+            slot.blocks = blocks
+            slot.table[:] = 0
+            slot.table[: len(blocks)] = blocks
+            slot.length = 0
+            slot.generated = []
+            slot.prompt = req.prompt
+            slot.plan = list(plan)
+            slot.max_new = req.max_new_tokens
+            slot.temperature = req.temperature
+            if req.temperature > 0.0:
+                # EXACTLY sample_decode_with_cache's key schedule: one
+                # split for the first token, then the step keys in bulk
+                rng, first_key = jax.random.split(req.rng)
+                slot.first_key = np.asarray(first_key)
+                slot.step_keys = (
+                    np.asarray(jax.random.split(rng, req.max_new_tokens - 1))
+                    if req.max_new_tokens > 1 else
+                    np.zeros((0, 2), np.uint32))
+            else:
+                slot.first_key = np.zeros((2,), np.uint32)
+                slot.step_keys = np.zeros((0, 2), np.uint32)
+            slot.result = self._results[req.rid]
+            slot.result.admitted_at = time.monotonic()
+            self.peak_blocks_in_use = max(
+                self.peak_blocks_in_use, self.allocator.blocks_in_use)
+
+    def _dispatch(self, fn, *args):
+        """Every device burst charges through the guard — the same
+        token-gated shape as the run-to-completion serving path."""
+        if self.guard is not None:
+            self.guard.acquire()
+        start = time.monotonic()
+        try:
+            out = jax.block_until_ready(fn(*args))
+        finally:
+            if self.guard is not None:
+                self.guard.charge((time.monotonic() - start) * 1e3)
+        return out
+
+    def _run_prefill_chunk(self, slot: _Slot) -> None:
+        # ONE lane per prefill dispatch: chunks are already MXU-shaped
+        # [width, d] work, so batching lanes buys nothing compute-wise —
+        # and a static multi-lane shape would bill every dispatch for
+        # its padded lanes (measured ~2x on the serving bench when most
+        # dispatches carry one mid-flight admission).  The first-token
+        # pick rides fused in the same dispatch.
+        start, width, last_row = slot.plan.pop(0)
+        final = not slot.plan
+        segment = slot.prompt[start: start + width]
+        if segment.size < width:  # short-prompt pad tail (dead rows)
+            segment = np.pad(segment, (0, width - segment.size))
+        picked, pk, pv = self._dispatch(
+            self._prefill_step, self.params, self.pool.k, self.pool.v,
+            jnp.asarray(slot.table[None]), jnp.asarray([start], np.int32),
+            jnp.ones((1,), bool), jnp.asarray(segment[None]),
+            jnp.asarray([last_row], np.int32),
+            # the pick is consumed only on the prompt's final chunk
+            jnp.asarray([slot.temperature if final else 0.0], np.float32),
+            jnp.asarray((slot.first_key if final else
+                         np.zeros(2, np.uint32))[None]))
+        self.pool = replace(self.pool, k=pk, v=pv)
+        self.prefill_chunks += 1
+        if not final:
+            return
+        # prompt fully cached: the fused pick at the final chunk's
+        # last-real-row logits IS the first token; join the decode pool
+        first = int(np.asarray(picked)[0])
+        slot.length = slot.prompt.size
+        slot.generated = [first]
+        slot.result.first_token_at = time.monotonic()
+        self.tokens_generated += 1
+        slot.state = "decode"
+        self._maybe_retire(slot, first)
+
+    def _run_decode_step(self, decode_slots: List[_Slot]) -> None:
+        ec = self.engine_config
+        s, span = ec.num_slots, ec.decode_span
+        tables = np.zeros((s, self._table_width), np.int32)
+        lengths = np.zeros((s,), np.int32)
+        active = np.zeros((s,), bool)
+        tokens = np.zeros((s,), np.int32)
+        temps = np.zeros((s,), np.float32)
+        keys = np.zeros((s, span, 2), np.uint32)
+        budgets = np.zeros((s,), np.int32)
+        for slot in decode_slots:
+            i = slot.idx
+            tables[i] = slot.table
+            lengths[i] = slot.length
+            active[i] = True
+            tokens[i] = slot.generated[-1]
+            temps[i] = slot.temperature
+            budgets[i] = slot.max_new - len(slot.generated)
+            if slot.temperature > 0.0:
+                # this span consumes the request's next step keys in the
+                # exact dense-split order
+                offset = len(slot.generated) - 1
+                window = slot.step_keys[offset: offset + span]
+                keys[i, : len(window)] = window
+        emitted, pk, pv = self._dispatch(
+            self._decode_step, self.params, self.pool.k, self.pool.v,
+            jnp.asarray(tables), jnp.asarray(lengths), jnp.asarray(active),
+            jnp.asarray(tokens), jnp.asarray(temps), jnp.asarray(keys),
+            jnp.asarray(budgets))
+        self.pool = replace(self.pool, k=pk, v=pv)
+        self.decode_steps += 1
+        emitted = np.asarray(emitted)  # [span, S]
+        for slot in decode_slots:
+            i = slot.idx
+            # mirror the device's lane-deactivation rule exactly: accept
+            # min(budget, span) tokens, truncated at EOS (inclusive) —
+            # every accepted token's K/V write happened on an alive lane
+            take = min(int(budgets[i]), span)
+            for t in range(take):
+                tok = int(emitted[t, i])
+                slot.length += 1
+                slot.generated.append(tok)
+                self.tokens_generated += 1
+                if ec.eos_token is not None and tok == ec.eos_token:
+                    break
+            self._maybe_retire(slot, slot.generated[-1])
+
+    def _maybe_retire(self, slot: _Slot, token: int) -> None:
+        eos = self.engine_config.eos_token
+        if len(slot.generated) >= slot.max_new or (
+                eos is not None and token == eos):
+            result = slot.result
+            result.tokens = list(slot.generated)
+            result.finished_at = time.monotonic()
+            self.allocator.reclaim(slot.blocks)
+            slot._clear()
+            slot.state = "free"

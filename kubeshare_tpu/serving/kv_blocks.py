@@ -1,0 +1,159 @@
+"""Block-paged KV cache: fixed-size blocks, block tables, free-list allocator.
+
+The dense cache (``models/decoding.init_kv_cache``) reserves
+``max_seq_len`` cache rows per batch row for the whole request lifetime —
+on a fractional-HBM pod that is the dominant allocation, and almost all
+of it is dead (a 40-token answer in a 2048-slot cache).  Here the cache
+is a static pool of fixed-size BLOCKS; each serving slot owns an ordered
+block table mapping its virtual token positions onto pool blocks, and a
+free-list allocator hands blocks out per request and takes them back at
+retirement — the cell allocator's reserve/reclaim discipline
+(``cell/allocator.py``) applied to HBM rows instead of chip fractions:
+reservation is explicit and up-front, release is loud about double
+frees, and exhaustion is an admission failure, never a silent
+clamp-overwrite.
+
+Everything device-side stays static-shaped: the pool tensors never grow,
+block tables are fixed-width int32, and the allocator is pure host-side
+bookkeeping — XLA never sees a shape change, so the serving engine's
+steps compile once.
+
+Block 0 is RESERVED as a scratch block: jitted steps route the writes of
+inactive slots there (a lane that must execute under jit but whose
+result must land nowhere).  The allocator never hands block 0 out.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List
+
+import jax.numpy as jnp
+
+from ..models.transformer import TransformerConfig
+
+
+class BlockExhausted(RuntimeError):
+    """The pool cannot fund a reservation.  Raised at ADMISSION time —
+    the caller queues or rejects the request; nothing mid-flight is ever
+    clamped or overwritten."""
+
+
+@dataclass(frozen=True)
+class PagedKVPool:
+    """The static device-side block pool.
+
+    ``k``/``v``: [n_layers, num_blocks, kv_heads, block_size, head_dim]
+    — one cache row per (block, offset) pair; a slot's virtual position
+    ``p`` lives at block ``table[p // block_size]``, offset
+    ``p % block_size``.
+    """
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    block_size: int
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+    def bytes_per_block(self) -> int:
+        """HBM cost of one block (K and V, all layers) — the allocation
+        granularity the serving docs size against."""
+        n_layers, _, kv_heads, block_size, head_dim = self.k.shape
+        return 2 * n_layers * kv_heads * block_size * head_dim * self.k.dtype.itemsize
+
+
+def init_paged_pool(
+    config: TransformerConfig, num_blocks: int, block_size: int
+) -> PagedKVPool:
+    """Allocate the static block pool (block 0 is the scratch block, so
+    ``num_blocks - 1`` are allocatable)."""
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    if num_blocks < 2:
+        raise ValueError(
+            f"num_blocks must be >= 2 (block 0 is reserved scratch), "
+            f"got {num_blocks}"
+        )
+    shape = (config.n_layers, num_blocks, config.kv_heads, block_size,
+             config.head_dim)
+    return PagedKVPool(
+        k=jnp.zeros(shape, config.dtype),
+        v=jnp.zeros(shape, config.dtype),
+        block_size=block_size,
+    )
+
+
+class BlockAllocator:
+    """Free-list allocator over pool block ids (host-side, O(1) ops).
+
+    LIFO reuse: the blocks a retired request returns are the first
+    handed to the next admission — the hot end of the pool stays hot,
+    and the recycle tests can watch reuse happen.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int) -> None:
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is reserved scratch), "
+                f"got {num_blocks}"
+            )
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # block 0 reserved; free list popped from the tail (LIFO)
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._owner: Dict[int, str] = {}  # block id -> request id
+        self._lock = threading.Lock()
+
+    @property
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def blocks_in_use(self) -> int:
+        with self._lock:
+            return len(self._owner)
+
+    def blocks_for_tokens(self, tokens: int) -> int:
+        """How many blocks cover ``tokens`` cache rows."""
+        return -(-tokens // self.block_size)
+
+    def reserve(self, count: int, owner: str) -> List[int]:
+        """Hand out ``count`` blocks or fail LOUDLY with the shortfall.
+
+        All-or-nothing: a partial grant would leave a request half-
+        admitted with no block for its next token — exactly the silent
+        clamp-overwrite failure mode the dense cache's headroom checks
+        exist to prevent.
+        """
+        if count < 1:
+            raise ValueError(f"count must be >= 1, got {count}")
+        with self._lock:
+            if count > len(self._free):
+                raise BlockExhausted(
+                    f"request {owner!r} needs {count} blocks but only "
+                    f"{len(self._free)} of {self.num_blocks - 1} are free "
+                    f"(block_size {self.block_size})"
+                )
+            blocks = [self._free.pop() for _ in range(count)]
+            for b in blocks:
+                self._owner[b] = owner
+            return blocks
+
+    def reclaim(self, blocks: List[int]) -> None:
+        """Return a retired request's blocks to the free list.  Double
+        frees and foreign ids raise — a corrupted table must never
+        silently donate another request's live blocks."""
+        with self._lock:
+            for b in blocks:
+                if b not in self._owner:
+                    raise ValueError(
+                        f"block {b} is not allocated (double free, or a "
+                        f"corrupted block table)"
+                    )
+            for b in blocks:
+                del self._owner[b]
+                self._free.append(b)

@@ -1,0 +1,230 @@
+"""Paged twins of the dense cached model steps.
+
+``models/decoding._decode_chunk`` reads and writes a dense
+[layers, batch, h_kv, max_seq, d] cache whose rows advance in lockstep
+(one scalar length for the whole batch).  Serving needs neither
+property: each slot sits at its OWN length, and its cache rows live
+scattered across pool blocks (kv_blocks.py).  The two entry points here
+keep the dense step's exact math — same projections, same rope, same
+per-query causal band through the SAME :func:`_attend_cached` — and swap
+only the cache plumbing:
+
+- :func:`paged_prefill_step`: a width-C prompt chunk writing its K/V
+  straight into a slot's blocks (no dense staging cache to copy from);
+- :func:`paged_decode_step`: one token for EVERY active slot at once —
+  per-slot positions, scatter-write each slot's K/V into its current
+  block, gather each slot's block list into a [S, h_kv, V, d] view, and
+  attend under per-row causal bands.
+
+Equivalence with the dense cache is test-locked (tests/test_serving.py):
+greedy and sampled streams from the paged pool match ``init_kv_cache``
+decoding exactly, GQA and windowed configs included.
+
+Inactive-slot lanes still execute under jit (static shapes); their
+writes are routed to the reserved scratch block 0 and their outputs
+ignored host-side.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.decoding import (
+    _attend_cached,
+    _check_moe_decodable,
+)
+from ..models.transformer import TransformerConfig, _rms_norm
+from ..ops.rope import apply_rope
+
+
+def paged_gather_kv(pool_k, pool_v, block_table):
+    """Materialize one slot's virtual K/V view.
+
+    ``pool_k``/``pool_v``: [n_layers, num_blocks, h_kv, bs, d];
+    ``block_table``: [T] int32.  Returns (k, v) each
+    [n_layers, h_kv, T*bs, d] — virtual position p at row p (block
+    ``table[p // bs]``, offset ``p % bs``).
+    """
+    n_layers, _, h_kv, bs, d = pool_k.shape
+    t = block_table.shape[0]
+
+    def view(pool):
+        blocks = pool[:, block_table]  # [L, T, h_kv, bs, d]
+        return blocks.transpose(0, 2, 1, 3, 4).reshape(n_layers, h_kv, t * bs, d)
+
+    return view(pool_k), view(pool_v)
+
+
+def _layer_views(pk_layer, pv_layer, tables, config: TransformerConfig):
+    """Per-lane virtual K/V views for ONE layer: pool [B, h_kv, bs, d]
+    gathered through lane tables [P, T] -> [P, h_kv, T*bs, d].  The one
+    view construction both paged steps attend through — a change here is
+    a change to the paged read path, full stop."""
+    p, t = tables.shape
+    bs = pk_layer.shape[2]
+
+    def view(pool):
+        return pool[tables].transpose(0, 2, 1, 3, 4).reshape(
+            p, config.kv_heads, t * bs, config.head_dim)
+
+    return view(pk_layer), view(pv_layer)
+
+
+def _moe_or_mlp(layer, config: TransformerConfig, y):
+    """The post-attention feed-forward shared by both paged steps —
+    identical contract to the dense step: MoE capacity pinned to the
+    token count so routing stays position- and batch-independent (a
+    co-batched slot cannot perturb another's outputs through
+    expert-capacity collisions)."""
+    if "moe" in layer:
+        from ..ops.moe import MoEConfig, moe_apply
+
+        _check_moe_decodable(config)
+        e, d_m, f = layer["moe"]["w_in"].shape
+        out, _ = moe_apply(
+            layer["moe"], y,
+            MoEConfig(d_model=d_m, d_ff=f, num_experts=e,
+                      capacity_factor=config.moe_capacity_factor,
+                      top_k=config.moe_top_k,
+                      dispatch=config.moe_dispatch),
+            capacity=y.shape[0] * y.shape[1],
+        )
+        return out.astype(config.dtype)
+    hidden = jax.nn.gelu(y @ layer["mlp"]["w_in"].astype(config.dtype))
+    return hidden @ layer["mlp"]["w_out"].astype(config.dtype)
+
+
+def paged_prefill_step(
+    params,
+    config: TransformerConfig,
+    pool_k,
+    pool_v,
+    tables,
+    starts,
+    active,
+    tokens,
+    last_rows,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One width-C prefill chunk for P slot lanes at once.
+
+    ``tokens`` [P, C] are each lane's chunk at virtual positions
+    ``starts[p] .. starts[p]+C-1`` against its own ``tables[p]``;
+    ``last_rows`` [P] select each lane's logits row (its prompt's final
+    real token, when this chunk is its last — with a bucket-padded tail
+    that is not the chunk's last row).  Returns
+    (logits [P, vocab], pool_k, pool_v).  The chunk's K/V land in the
+    blocks first, then its queries attend the lane's whole gathered
+    view under the per-query causal band — intra-chunk causality falls
+    out of the same mask that orders chunk vs history, exactly like the
+    dense ``_decode_chunk``.  Only the selected rows' lm_head projection
+    is computed (a full [P, C, vocab] f32 buffer would dominate the
+    step at real vocab sizes).
+
+    Inactive lanes write to the scratch block and compute garbage the
+    caller ignores.  NOTE: the engine deliberately dispatches P=1 (one
+    lane per chunk) — a static multi-lane shape bills every dispatch
+    for its padded lanes, measured ~2x worse on the serving bench; see
+    engine._run_prefill_chunk before batching lanes here.
+    """
+    dtype = config.dtype
+    chunk = tokens.shape[1]
+    bs = pool_k.shape[3]
+    positions = starts[:, None] + jnp.arange(chunk)[None, :]  # [P, C]
+    blk = jnp.take_along_axis(tables, positions // bs, axis=1)  # [P, C]
+    blk = jnp.where(active[:, None], blk, 0)
+    off = positions % bs
+    x = params["embed"][tokens].astype(dtype)  # [P, C, d]
+    use_rope = config.positional == "rope"
+    if not use_rope:
+        x = x + params["pos_embed"][positions].astype(dtype)
+
+    new_k, new_v = [], []
+    for layer_idx, layer in enumerate(params["layers"]):
+        y = _rms_norm(x, layer["norm1"]["scale"])
+        q = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wq"].astype(dtype))
+        k = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wk"].astype(dtype))
+        v = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wv"].astype(dtype))
+        if use_rope:
+            q = apply_rope(q, positions)  # [P, C]: per-lane positions
+            k = apply_rope(k, positions)
+        # rows (blk[p,i], :, off[p,i], :) <- k[p, :, i, :]
+        pk = pool_k[layer_idx].at[blk, :, off, :].set(k.transpose(0, 2, 1, 3))
+        pv = pool_v[layer_idx].at[blk, :, off, :].set(v.transpose(0, 2, 1, 3))
+        new_k.append(pk)
+        new_v.append(pv)
+        view_k, view_v = _layer_views(pk, pv, tables, config)
+        o = _attend_cached(
+            q, view_k, view_v, positions, window=config.attention_window
+        ).astype(dtype)
+        x = x + jnp.einsum("bhsk,hkd->bsd", o, layer["attn"]["wo"].astype(dtype))
+        y = _rms_norm(x, layer["norm2"]["scale"])
+        x = x + _moe_or_mlp(layer, config, y)
+
+    x = _rms_norm(x, params["final_norm"]["scale"])
+    head_in = jnp.take_along_axis(x, last_rows[:, None, None], axis=1)  # [P,1,d]
+    logits = (head_in @ params["lm_head"].astype(dtype)).astype(jnp.float32)
+    return logits[:, 0], jnp.stack(new_k), jnp.stack(new_v)
+
+
+def paged_decode_step(
+    params,
+    config: TransformerConfig,
+    pool_k,
+    pool_v,
+    block_tables,
+    lengths,
+    active,
+    tokens,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode token for every slot in the pool at once.
+
+    ``tokens`` [S] (this step's input token per slot, 0 for inactive
+    slots), ``lengths`` [S] (each slot's cache fill = this write's
+    position), ``block_tables`` [S, T], ``active`` [S] bool.  Returns
+    (logits [S, vocab], pool_k, pool_v); inactive rows compute garbage
+    the caller ignores — their K/V writes are routed to the scratch
+    block so the pool's live data is never touched.
+    """
+    dtype = config.dtype
+    bs = pool_k.shape[3]
+    positions = lengths  # [S]
+    # each slot's write target; inactive lanes land in scratch block 0
+    blk = jnp.take_along_axis(
+        block_tables, (positions // bs)[:, None], axis=1)[:, 0]
+    blk = jnp.where(active, blk, 0)
+    off = positions % bs
+    x = params["embed"][tokens].astype(dtype)[:, None, :]  # [S, 1, d]
+    use_rope = config.positional == "rope"
+    if not use_rope:
+        x = x + params["pos_embed"][positions].astype(dtype)[:, None, :]
+
+    new_k, new_v = [], []
+    for layer_idx, layer in enumerate(params["layers"]):
+        y = _rms_norm(x, layer["norm1"]["scale"])
+        q = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wq"].astype(dtype))
+        k = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wk"].astype(dtype))
+        v = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wv"].astype(dtype))
+        if use_rope:
+            # [S, 1]: every slot rotates by its own position
+            q = apply_rope(q, positions[:, None])
+            k = apply_rope(k, positions[:, None])
+        pk = pool_k[layer_idx].at[blk, :, off, :].set(k[:, :, 0, :])
+        pv = pool_v[layer_idx].at[blk, :, off, :].set(v[:, :, 0, :])
+        new_k.append(pk)
+        new_v.append(pv)
+        # gather every slot's block list into its virtual view [S,h_kv,V,d]
+        view_k, view_v = _layer_views(pk, pv, block_tables, config)
+        o = _attend_cached(
+            q, view_k, view_v, positions[:, None],
+            window=config.attention_window,
+        ).astype(dtype)
+        x = x + jnp.einsum("bhsk,hkd->bsd", o, layer["attn"]["wo"].astype(dtype))
+        y = _rms_norm(x, layer["norm2"]["scale"])
+        x = x + _moe_or_mlp(layer, config, y)
+
+    x = _rms_norm(x, params["final_norm"]["scale"])
+    logits = (x @ params["lm_head"].astype(dtype)).astype(jnp.float32)
+    return logits[:, 0], jnp.stack(new_k), jnp.stack(new_v)

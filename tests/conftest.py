@@ -22,6 +22,20 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "serving: continuous-batching serving engine suite (tier-1; "
+        "kept fast — heavyweight captures live in benchmarks/"
+        "serving_bench.py)",
+    )
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the driver's tier-1 verify command "
+        "(ROADMAP.md runs pytest with -m 'not slow')",
+    )
+
+
 def _build_native() -> None:
     """Build the native runtime, interposer fixtures, and TSAN binaries so a
     fresh checkout runs the full isolation suite instead of silently

@@ -1231,8 +1231,12 @@ class TestDecoding:
         np.testing.assert_array_equal(np.asarray(one_shot),
                                       np.asarray(split))
 
-    def test_chunked_prefill_validates_tiling(self):
-        from kubeshare_tpu.models.decoding import prefill_chunked
+    def test_chunked_prefill_ragged_and_chunk_validation(self):
+        """Non-tiling prompts no longer raise: the ragged tail runs as
+        one bucketed (power-of-two) chunk and must match the bulk
+        prefill (tests/test_serving.py locks every remainder); a
+        degenerate chunk still fails loudly."""
+        from kubeshare_tpu.models.decoding import prefill, prefill_chunked
         from kubeshare_tpu.models.transformer import (
             TransformerConfig, transformer_init)
 
@@ -1240,9 +1244,13 @@ class TestDecoding:
             vocab_size=64, d_model=32, n_heads=4, n_layers=1, d_ff=64,
             max_seq_len=32, dtype=jnp.float32, attention="reference")
         params = transformer_init(jax.random.PRNGKey(0), config)
-        prompt = jnp.zeros((1, 10), jnp.int32)
-        with pytest.raises(ValueError, match="tile"):
-            prefill_chunked(params, config, prompt, 4)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 10), 0, 64)
+        cache_b, logits_b = prefill(params, config, prompt)
+        cache_c, logits_c = prefill_chunked(params, config, prompt, 4)
+        np.testing.assert_allclose(
+            np.asarray(logits_c), np.asarray(logits_b),
+            rtol=2e-4, atol=2e-4)
+        assert int(cache_c["length"]) == 10
         with pytest.raises(ValueError, match="chunk"):
             prefill_chunked(params, config, prompt, 0)
 

@@ -1,0 +1,453 @@
+"""Serving subsystem tests: paged KV cache, continuous batching, ragged
+prefill buckets.
+
+The contract under test is the strongest one a serving stack can make:
+the paged pool + continuous-batching engine must emit EXACTLY the token
+stream the dense-cache reference paths emit — per request, regardless of
+what else is co-batched in the pool, which slot the request landed in,
+or whose blocks it recycled.  Plus the allocator's loud-failure
+discipline and the zero-recompile property the TPU serving story depends
+on.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeshare_tpu.models.transformer import TransformerConfig, transformer_init
+
+pytestmark = pytest.mark.serving
+
+
+def _small_config(**extra):
+    return TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32, attention="reference", **extra)
+
+
+def _engine(params, config, **overrides):
+    from kubeshare_tpu.serving import EngineConfig, ServingEngine
+
+    kwargs = dict(num_slots=3, block_size=4, num_blocks=41,
+                  max_request_len=48, prefill_chunk=8)
+    kwargs.update(overrides)
+    return ServingEngine(params, config, EngineConfig(**kwargs))
+
+
+class TestBlockAllocator:
+    def test_exhaustion_is_loud_and_all_or_nothing(self):
+        from kubeshare_tpu.serving import BlockAllocator, BlockExhausted
+
+        alloc = BlockAllocator(num_blocks=5, block_size=4)  # 4 allocatable
+        got = alloc.reserve(3, "a")
+        assert len(got) == 3 and 0 not in got
+        with pytest.raises(BlockExhausted, match="needs 2 blocks"):
+            alloc.reserve(2, "b")
+        # the failed reservation granted NOTHING
+        assert alloc.free_blocks == 1
+        assert alloc.blocks_in_use == 3
+
+    def test_double_free_raises(self):
+        from kubeshare_tpu.serving import BlockAllocator
+
+        alloc = BlockAllocator(num_blocks=5, block_size=4)
+        blocks = alloc.reserve(2, "a")
+        alloc.reclaim(blocks)
+        with pytest.raises(ValueError, match="double free"):
+            alloc.reclaim(blocks)
+        with pytest.raises(ValueError, match="not allocated"):
+            alloc.reclaim([0])  # the scratch block is never allocated
+
+    def test_reclaimed_blocks_are_reused_first(self):
+        from kubeshare_tpu.serving import BlockAllocator
+
+        alloc = BlockAllocator(num_blocks=9, block_size=4)
+        first = alloc.reserve(3, "a")
+        alloc.reclaim(first)
+        again = alloc.reserve(3, "b")
+        # LIFO free list: the retired request's blocks come back first
+        assert set(again) == set(first)
+
+    def test_blocks_for_tokens(self):
+        from kubeshare_tpu.serving import BlockAllocator
+
+        alloc = BlockAllocator(num_blocks=9, block_size=4)
+        assert [alloc.blocks_for_tokens(n) for n in (1, 4, 5, 8, 9)] == [
+            1, 1, 2, 2, 3]
+
+
+class TestPagedEquivalence:
+    """Greedy and sampled streams from the paged pool must match the
+    dense cache exactly — the bit-exactness the ISSUE's read path
+    promises, locked at the emitted-token level."""
+
+    def test_greedy_matches_dense_across_configs(self):
+        from kubeshare_tpu.models.decoding import greedy_decode
+        from kubeshare_tpu.serving import Request
+
+        cases = {
+            "mha": dict(),
+            "gqa_rope": dict(n_kv_heads=2, positional="rope"),
+            "windowed": dict(attention_window=6),
+            "moe": dict(moe_every=2, moe_num_experts=4, moe_top_k=2),
+        }
+        for name, extra in cases.items():
+            config = _small_config(**extra)
+            params = transformer_init(jax.random.PRNGKey(0), config)
+            prompt = np.asarray(jax.random.randint(
+                jax.random.PRNGKey(1), (13,), 0, 64), np.int32)
+            dense = np.asarray(greedy_decode(
+                params, config, jnp.asarray(prompt)[None], 8))[0]
+            engine = _engine(params, config)
+            engine.submit(Request("r0", prompt, 8))
+            out = engine.run()["r0"]
+            assert out.tokens == list(dense), name
+
+    def test_sampled_matches_dense(self):
+        """Same rng => the engine reproduces sample_decode_with_cache's
+        stream exactly (temperature + top-k + top-p filtered)."""
+        from kubeshare_tpu.models.decoding import sample_decode
+        from kubeshare_tpu.serving import Request
+
+        config = _small_config(n_kv_heads=2, positional="rope")
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        prompt = np.asarray(jax.random.randint(
+            jax.random.PRNGKey(1), (10,), 0, 64), np.int32)
+        rng = jax.random.PRNGKey(7)
+        dense = np.asarray(sample_decode(
+            params, config, jnp.asarray(prompt)[None], rng, 6,
+            temperature=0.8, top_k=10, top_p=0.95))[0]
+        engine = _engine(params, config, top_k=10, top_p=0.95)
+        engine.submit(Request("r0", prompt, 6, temperature=0.8, rng=rng))
+        out = engine.run()["r0"]
+        assert out.tokens == list(dense)
+
+    def test_paged_pool_rows_match_dense_cache(self):
+        """Below the token level: the slot's gathered K/V rows equal the
+        dense cache's rows after the same prefill."""
+        from kubeshare_tpu.models.decoding import prefill
+        from kubeshare_tpu.serving import Request, paged_gather_kv
+
+        config = _small_config(n_kv_heads=2, positional="rope")
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        prompt = np.asarray(jax.random.randint(
+            jax.random.PRNGKey(2), (11,), 0, 64), np.int32)
+        dense_cache, _ = prefill(params, config, jnp.asarray(prompt)[None])
+        engine = _engine(params, config)
+        engine.submit(Request("r0", prompt, 1))
+        engine.run()
+        # request retired, but its writes are still in the pool; rebuild
+        # its view through the blocks it was using (LIFO: re-reserve)
+        blocks = engine.allocator.reserve(
+            engine.allocator.blocks_for_tokens(12), "probe")
+        table = np.zeros(engine._table_width, np.int32)
+        # the original table listed blocks in reservation order; the
+        # LIFO reclaim + re-reserve hands them back reversed
+        table[: len(blocks)] = list(reversed(blocks))
+        k_view, _ = paged_gather_kv(engine.pool.k, engine.pool.v,
+                                    jnp.asarray(table))
+        np.testing.assert_allclose(
+            np.asarray(k_view[:, :, :11]),
+            np.asarray(dense_cache["k"][:, 0, :, :11]),
+            rtol=1e-6, atol=1e-6)
+
+
+class TestContinuousBatching:
+    def test_mixed_lengths_match_solo_references(self):
+        """The killer property: 10 mixed-length requests squeezed
+        through 3 slots — admitted mid-flight, recycling retired slots'
+        blocks — each emit exactly their SOLO dense-path stream."""
+        from kubeshare_tpu.models.decoding import greedy_decode
+        from kubeshare_tpu.serving import Request
+
+        config = _small_config(n_kv_heads=2, positional="rope")
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        rng = np.random.default_rng(3)
+        # 7 requests over 3 slots; lengths chosen to hit full-chunk,
+        # ragged-tail, and short-pad prefill plans (repeated (L, new)
+        # pairs keep the dense-reference compile count down — tier-1
+        # time is compile-dominated at this model size)
+        shapes = [(1, 3), (5, 8), (13, 4), (21, 11), (5, 8), (13, 4),
+                  (29, 2)]
+        reqs = [(f"r{i}", rng.integers(0, 64, length), new)
+                for i, (length, new) in enumerate(shapes)]
+        engine = _engine(params, config)
+        for rid, prompt, new in reqs:
+            engine.submit(Request(rid, prompt, new))
+        out = engine.run()
+        for rid, prompt, new in reqs:
+            ref = np.asarray(greedy_decode(
+                params, config, jnp.asarray(prompt, jnp.int32)[None], new))[0]
+            assert out[rid].tokens == list(ref), rid
+        # every retired request's blocks went home
+        assert engine.allocator.blocks_in_use == 0
+        assert engine.allocator.free_blocks == engine.allocator.num_blocks - 1
+        # a live-loop server evicts completed results instead of letting
+        # the result map grow with every request ever served
+        popped = engine.pop_finished()
+        assert sorted(popped) == sorted(rid for rid, _, _ in reqs)
+        assert engine.pop_finished() == {}
+        # and the pool was actually oversubscribed: peak in-use is under
+        # what 10 requests would need simultaneously
+        total_demand = sum(
+            engine.allocator.blocks_for_tokens(len(p) + n)
+            for _, p, n in reqs)
+        assert 0 < engine.peak_blocks_in_use < total_demand
+
+    def test_admission_waits_on_block_exhaustion(self):
+        """A request the pool can't fund YET queues (no clamp, no drop)
+        and admits after a retirement frees blocks; a request that can
+        NEVER fit fails loudly at submit."""
+        from kubeshare_tpu.serving import BlockExhausted, Request
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        # 6 allocatable blocks x 4 = 24 rows total
+        engine = _engine(params, config, num_slots=2, num_blocks=7,
+                         max_request_len=32)
+        prompt = np.zeros(17, np.int32)  # 17 + 3 -> 5 blocks each
+        engine.submit(Request("big0", prompt, 3))
+        engine.submit(Request("big1", prompt, 3))
+        engine.step()  # admits big0 (5 blocks); big1 (5 > 3 free) waits
+        assert engine.result("big0").admitted_at is not None
+        assert engine.result("big1").admitted_at is None
+        out = engine.run()  # big0 retires -> big1 admits and completes
+        assert len(out["big1"].tokens) == 3
+        with pytest.raises(BlockExhausted, match="NEVER"):
+            engine.submit(Request("huge", np.zeros(30, np.int32), 2))
+
+    def test_submit_validation_is_loud(self):
+        from kubeshare_tpu.serving import Request
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        engine = _engine(params, config)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            engine.submit(Request("a", np.zeros(4, np.int32), 0))
+        with pytest.raises(ValueError, match="max_request_len"):
+            engine.submit(Request("b", np.zeros(40, np.int32), 20))
+        with pytest.raises(ValueError, match="rng"):
+            engine.submit(Request("c", np.zeros(4, np.int32), 2,
+                                  temperature=0.7))
+        with pytest.raises(ValueError, match="non-empty"):
+            engine.submit(Request("d", np.zeros(0, np.int32), 2))
+
+    def test_short_pool_caps_pad_bucket(self):
+        """A max_request_len below the prefill bucket must not reject a
+        request that actually fits (review regression): prompt 17 +
+        3 new = 20 rows in a 24-row bound with chunk 32 used to be
+        refused over the uncapped 32-row pad bucket."""
+        from kubeshare_tpu.models.decoding import greedy_decode
+        from kubeshare_tpu.serving import Request
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        engine = _engine(params, config, num_slots=2, num_blocks=15,
+                         max_request_len=24, prefill_chunk=32)
+        prompt = np.asarray(jax.random.randint(
+            jax.random.PRNGKey(5), (17,), 0, 64), np.int32)
+        engine.warmup()
+        baseline = engine.compile_counts()
+        engine.submit(Request("r0", prompt, 3))
+        out = engine.run()["r0"]
+        ref = np.asarray(greedy_decode(
+            params, config, jnp.asarray(prompt)[None], 3))[0]
+        assert out.tokens == list(ref)
+        # the capped (non-power-of-two) pad width was part of warmup
+        assert engine.compile_counts() == baseline
+
+    def test_eos_retires_early_and_frees_blocks(self):
+        from kubeshare_tpu.models.decoding import greedy_decode
+        from kubeshare_tpu.serving import Request
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        prompt = np.asarray(jax.random.randint(
+            jax.random.PRNGKey(1), (9,), 0, 64), np.int32)
+        ref = [int(t) for t in np.asarray(greedy_decode(
+            params, config, jnp.asarray(prompt)[None], 8))[0]]
+        eos = ref[2]  # the 3rd greedy token becomes "EOS"
+        engine = _engine(params, config, eos_token=eos)
+        engine.submit(Request("r0", prompt, 8))
+        out = engine.run()["r0"]
+        # stops AT the stream's first eos occurrence (which may precede
+        # index 2 if the token repeats), mid-decode-span included
+        assert out.tokens == ref[: ref.index(eos) + 1]
+        assert len(out.tokens) < len(ref)
+        assert engine.allocator.blocks_in_use == 0
+
+    def test_zero_recompilation_after_warmup(self):
+        """The acceptance criterion, asserted via jit cache stats: after
+        warmup, a full mixed ragged workload adds ZERO compilations, and
+        the prefill widths stay within the O(log chunk) bucket bound."""
+        import math
+
+        from kubeshare_tpu.serving import Request
+
+        config = _small_config(n_kv_heads=2, positional="rope")
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        engine = _engine(params, config)
+        engine.warmup()
+        baseline = engine.compile_counts()
+        chunk = engine.engine_config.prefill_chunk
+        # widths bucketed to powers of two, lane counts to {1, num_slots}
+        assert baseline["prefill"] <= 2 * (int(math.log2(chunk)) + 1)
+        assert baseline["decode"] == 1
+        rng = np.random.default_rng(5)
+        for i in range(8):  # every remainder class over two waves
+            engine.submit(Request(
+                f"r{i}", rng.integers(0, 64, 2 * chunk + 1 + i),
+                int(rng.integers(1, 6))))
+        engine.run()
+        assert engine.compile_counts() == baseline
+
+    def test_engine_charges_through_guard(self):
+        """Fractional-chip integration: every prefill chunk / decode
+        step / first-token pick acquires and charges the token guard."""
+        from kubeshare_tpu.isolation.guard import ExecutionGuard
+        from kubeshare_tpu.serving import EngineConfig, Request, ServingEngine
+
+        class FakeClient:
+            def __init__(self):
+                self.acquired = 0
+                self.released_ms = 0.0
+
+            def acquire(self, estimate_ms):
+                self.acquired += 1
+                return 1e9  # one grant funds the whole run
+
+            def release(self, used_ms):
+                self.released_ms += used_ms
+
+        client = FakeClient()
+        guard = ExecutionGuard(client=client, from_env=False,
+                               idle_release_ms=0)
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        engine = ServingEngine(
+            params, config,
+            EngineConfig(num_slots=2, block_size=4, num_blocks=17,
+                         max_request_len=32, prefill_chunk=8),
+            guard=guard)
+        engine.submit(Request("r0", np.zeros(9, np.int32), 4))
+        engine.run()
+        assert client.acquired >= 1
+        assert guard.total_gated_ms > 0.0
+        # run() returned the held token at drain
+        assert client.released_ms > 0.0
+
+
+class TestRaggedPrefill:
+    """Satellite: prefill_chunked accepts non-tiling prompts via
+    power-of-two bucketed final chunks."""
+
+    def test_matches_bulk_across_remainders(self):
+        from kubeshare_tpu.models.decoding import prefill, prefill_chunked
+
+        config = _small_config(n_kv_heads=2, positional="rope")
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        # short-pad, pow2, ragged-with-full-chunks, exact-tile, long-ragged
+        for length in (3, 8, 11, 16, 21):
+            prompt = jax.random.randint(
+                jax.random.PRNGKey(length), (2, length), 0, 64)
+            cache_b, logits_b = prefill(params, config, prompt)
+            cache_c, logits_c = prefill_chunked(params, config, prompt, 8)
+            np.testing.assert_allclose(
+                np.asarray(logits_c), np.asarray(logits_b),
+                rtol=2e-4, atol=2e-4, err_msg=f"L={length}")
+            np.testing.assert_allclose(
+                np.asarray(cache_c["k"]), np.asarray(cache_b["k"]),
+                rtol=2e-4, atol=2e-4, err_msg=f"L={length}")
+            np.testing.assert_allclose(
+                np.asarray(cache_c["v"]), np.asarray(cache_b["v"]),
+                rtol=2e-4, atol=2e-4, err_msg=f"L={length}")
+            assert int(cache_c["length"]) == length
+
+    def test_compile_count_bounded_by_buckets(self):
+        """Compile-count regression: across EVERY remainder the chunk
+        widths hitting the compiler stay within {chunk} + powers of two
+        — O(log chunk) shapes, not one per remainder."""
+        import math
+
+        from kubeshare_tpu.models import decoding
+
+        config = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=1, d_ff=64,
+            max_seq_len=64, dtype=jnp.float32, attention="reference")
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        chunk = 8
+        widths = set()
+        real = decoding._decode_chunk
+
+        def recording(params, config, cache, tokens, *args, **kwargs):
+            widths.add(int(tokens.shape[1]))
+            return real(params, config, cache, tokens, *args, **kwargs)
+
+        try:
+            decoding._decode_chunk = recording
+            for length in range(1, 2 * chunk + 1):
+                prompt = jnp.zeros((1, length), jnp.int32)
+                decoding.prefill_chunked(params, config, prompt, chunk)
+        finally:
+            decoding._decode_chunk = real
+        allowed = {chunk} | {2 ** i for i in range(int(math.log2(chunk)) + 1)}
+        assert widths <= allowed, widths
+        assert len(widths) <= int(math.log2(chunk)) + 1
+
+    def test_bucket_capped_at_max_seq_len(self):
+        """A non-power-of-two max_seq_len below the bucket must not make
+        the pad-forward chunk overrun the cache (review regression):
+        prompt 17 in a 20-row cache with chunk 32 bucketed to 32 used to
+        crash in XLA."""
+        from kubeshare_tpu.models.decoding import prefill, prefill_chunked
+        from kubeshare_tpu.models.transformer import (
+            TransformerConfig, transformer_init)
+
+        config = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=1, d_ff=64,
+            max_seq_len=20, dtype=jnp.float32, attention="reference")
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        prompt = jax.random.randint(jax.random.PRNGKey(4), (1, 17), 0, 64)
+        cache_b, logits_b = prefill(params, config, prompt)
+        cache_c, logits_c = prefill_chunked(params, config, prompt, 32)
+        np.testing.assert_allclose(
+            np.asarray(logits_c), np.asarray(logits_b),
+            rtol=2e-4, atol=2e-4)
+        assert int(cache_c["length"]) == 17
+
+    def test_bucket_width(self):
+        from kubeshare_tpu.models.decoding import bucket_width
+
+        assert [bucket_width(r, 8) for r in (1, 2, 3, 4, 5, 7, 8)] == [
+            1, 2, 4, 4, 8, 8, 8]
+        with pytest.raises(ValueError):
+            bucket_width(0, 8)
+        with pytest.raises(ValueError):
+            bucket_width(9, 8)
+
+
+class TestServingBenchSmoke:
+    def test_smoke_ratio_and_zero_recompiles(self):
+        """The bench's CPU smoke path: continuous vs run-to-completion
+        on a Poisson mixed-length workload, seconds-fast, recompile-free
+        after warmup."""
+        import importlib.util
+        import os
+
+        spec = importlib.util.spec_from_file_location(
+            "serving_bench", os.path.join(
+                os.path.dirname(__file__), "..", "benchmarks",
+                "serving_bench.py"))
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        result = bench.run_bench(bench.smoke_settings())
+        assert result["recompiles_after_warmup"] == 0
+        assert result["continuous"]["tokens_per_s"] > 0
+        assert result["run_to_completion"]["tokens_per_s"] > 0
+        # the smoke model is toy-sized and its sub-100ms serve windows
+        # jitter with batch-formation timing, so the ratio is noisy
+        # (0.5-0.9 observed) and FAR under the full bench's (1.75-2.06x
+        # measured — docs/perf.md); this test locks the mechanics and
+        # the recompile-free property, not the 1.5x criterion
+        assert result["ratio"] > 0.25
