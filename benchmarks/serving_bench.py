@@ -38,10 +38,22 @@ Methodology (the serving section of docs/perf.md records results):
   continuous/run-to-completion RATIO is the trustworthy number;
   absolute tokens/s drift with host load.
 
+- ``--shared-prefix`` switches to the PREFIX-CACHE comparison: one
+  trace where a fraction of requests share a long common prompt prefix
+  (the shared-system-prompt / few-shot-template traffic shape), replayed
+  against the SAME engine geometry with the radix prefix cache enabled
+  vs disabled — identical pool, identical KV-HBM budget, so the ratio
+  isolates exactly what admission-time prefix matching + CoW + LRU
+  eviction buy.  Skipped prefill tokens are read back from the new
+  serving metrics families (the collector-plane scrape surface), not
+  from bench-side arithmetic.
+
 Run:
 
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --smoke
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py            # full
+    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --shared-prefix
+    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --shared-prefix --smoke
     make serve-smoke
 """
 
@@ -97,6 +109,41 @@ def default_settings() -> dict:
     )
 
 
+def shared_smoke_settings() -> dict:
+    """Seconds-fast shared-prefix path (CI, tests/test_serving.py):
+    60% of requests open with the same 44-token prefix — deliberately
+    NOT a block multiple (block_size 8), so every hit ends mid-block
+    and the copy-on-write dispatch runs in CI too."""
+    return dict(
+        d_model=128, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=256,
+        vocab_size=512, max_seq_len=96,
+        num_requests=20,
+        num_slots=4, block_size=8, num_blocks=49,
+        max_request_len=96, prefill_chunk=32,
+        prompt_lo=8, prompt_hi=64, new_lo=4, new_hi=16,
+        shared_fraction=0.6, prefix_len=44, tail_lo=4, tail_hi=16,
+        mean_interarrival_s=0.01, seed=0,
+    )
+
+
+def shared_settings() -> dict:
+    """The shared-prefix capture configuration: 60% of requests share a
+    256-token prefix (the acceptance shape) over the full-bench model;
+    arrivals paced so the cache can warm the way live traffic warms it
+    (the first sharer must retire before later sharers can hit)."""
+    return dict(
+        d_model=256, n_layers=4, n_heads=8, n_kv_heads=2, d_ff=1024,
+        vocab_size=4096, max_seq_len=320,
+        num_requests=48,
+        num_slots=12, block_size=16, num_blocks=161,
+        max_request_len=320, prefill_chunk=64,
+        prompt_lo=8, prompt_hi=192, new_lo=4, new_hi=32,
+        # 256 + 16 + 32 = 304 rows worst case, inside max_request_len
+        shared_fraction=0.6, prefix_len=256, tail_lo=8, tail_hi=16,
+        mean_interarrival_s=0.02, seed=0,
+    )
+
+
 def build_workload(s: dict):
     """One shared trace: (rid, prompt, max_new, arrival_offset_s)."""
     rng = np.random.default_rng(s["seed"])
@@ -111,19 +158,48 @@ def build_workload(s: dict):
     return trace
 
 
+def build_shared_workload(s: dict):
+    """Shared-prefix trace: ``shared_fraction`` of requests open with
+    one common ``prefix_len``-token prefix followed by a private tail
+    (few-shot template traffic); the rest are the mixed-length
+    background.  Returns (trace, sharer_rids)."""
+    rng = np.random.default_rng(s["seed"])
+    prefix = rng.integers(0, s["vocab_size"], s["prefix_len"]).astype(np.int32)
+    trace, sharers = [], set()
+    t = 0.0
+    for i in range(s["num_requests"]):
+        t += float(rng.exponential(s["mean_interarrival_s"]))
+        rid = f"req{i}"
+        max_new = int(rng.integers(s["new_lo"], s["new_hi"] + 1))
+        if rng.random() < s["shared_fraction"]:
+            tail = rng.integers(
+                0, s["vocab_size"],
+                int(rng.integers(s["tail_lo"], s["tail_hi"] + 1)))
+            prompt = np.concatenate([prefix, tail]).astype(np.int32)
+            sharers.add(rid)
+        else:
+            prompt = rng.integers(
+                0, s["vocab_size"],
+                int(rng.integers(s["prompt_lo"], s["prompt_hi"] + 1))
+            ).astype(np.int32)
+        trace.append((rid, prompt, max_new, t))
+    return trace, sharers
+
+
 def _percentiles(values, ps=(50, 95)):
     if not values:
         return {f"p{p}": None for p in ps}
     return {f"p{p}": float(np.percentile(np.asarray(values), p)) for p in ps}
 
 
-def run_continuous(params, config, s: dict, trace) -> dict:
+def run_continuous(params, config, s: dict, trace,
+                   prefix_cache: bool = True) -> dict:
     from kubeshare_tpu.serving import EngineConfig, Request, ServingEngine
 
     engine = ServingEngine(params, config, EngineConfig(
         num_slots=s["num_slots"], block_size=s["block_size"],
         num_blocks=s["num_blocks"], max_request_len=s["max_request_len"],
-        prefill_chunk=s["prefill_chunk"]))
+        prefill_chunk=s["prefill_chunk"], prefix_cache=prefix_cache))
     engine.warmup()
     compiles_before = engine.compile_counts()
 
@@ -149,6 +225,10 @@ def run_continuous(params, config, s: dict, trace) -> dict:
         if len(r.tokens) > 1:
             per_token.append(
                 (r.finished_at - r.first_token_at) / (len(r.tokens) - 1))
+    # prefix-cache stats read back through the metrics surface (the
+    # same families Prometheus scrapes), not private engine state
+    metric = {(sm.name, tuple(sorted(sm.labels.items()))): sm.value
+              for f in engine.collect_metrics() for sm in f.samples}
     return {
         "tokens_per_s": useful / elapsed,
         "useful_tokens": useful,
@@ -159,6 +239,16 @@ def run_continuous(params, config, s: dict, trace) -> dict:
         "prefill_chunks": engine.prefill_chunks,
         "kv_hbm_bytes_peak": engine.peak_blocks_in_use
         * engine.pool.bytes_per_block(),
+        "prefix_hit_tokens": int(metric[
+            ("kubeshare_serving_prefix_hit_tokens_total", ())]),
+        "prefix_hit_requests": int(metric[
+            ("kubeshare_serving_prefix_cache_requests_total",
+             (("result", "hit"),))]),
+        "cow_copies": int(metric[
+            ("kubeshare_serving_dispatches_total",
+             (("kind", "cow_copy"),))]),
+        "evicted_blocks": int(metric[
+            ("kubeshare_serving_prefix_evicted_blocks_total", ())]),
         "recompiles": recompiles,
     }
 
@@ -258,7 +348,11 @@ def run_bench(s: dict) -> dict:
             f"rtc_batch*max_seq_len")
     trace = build_workload(s)
 
-    continuous = run_continuous(params, config, s, trace)
+    # prefix cache OFF: this suite isolates the SCHEDULING win
+    # (continuous batching vs batch barriers) per the methodology above;
+    # --shared-prefix owns the cache-on comparison
+    continuous = run_continuous(params, config, s, trace,
+                                prefix_cache=False)
     rtc = run_rtc(params, config, s, trace)
     recompiles = continuous.pop("recompiles") + rtc.pop("recompiles")
     if recompiles:
@@ -281,21 +375,83 @@ def run_bench(s: dict) -> dict:
     }
 
 
+def run_shared_bench(s: dict) -> dict:
+    """Prefix cache ON vs OFF on one shared-prefix trace: same engine
+    geometry, same pool, same KV-HBM budget — the ratio isolates the
+    radix cache (admission matching + CoW + LRU eviction) alone."""
+    from kubeshare_tpu.models.transformer import (
+        TransformerConfig, transformer_init)
+
+    config = TransformerConfig(
+        vocab_size=s["vocab_size"], d_model=s["d_model"],
+        n_heads=s["n_heads"], n_kv_heads=s["n_kv_heads"],
+        n_layers=s["n_layers"], d_ff=s["d_ff"],
+        max_seq_len=s["max_seq_len"], dtype=jnp.float32,
+        positional="rope", attention="reference")
+    params = transformer_init(jax.random.PRNGKey(s["seed"]), config)
+    trace, sharers = build_shared_workload(s)
+
+    cached = run_continuous(params, config, s, trace, prefix_cache=True)
+    uncached = run_continuous(params, config, s, trace, prefix_cache=False)
+    recompiles = cached.pop("recompiles") + uncached.pop("recompiles")
+    if recompiles:
+        raise RuntimeError(
+            f"{recompiles} recompilations after warmup — a static-shape "
+            f"leak; the comparison (and a TPU serving pod) is invalid")
+    # what a perfect cache could have skipped: every sharer's prefix
+    # tokens (the first sharer must always prefill cold)
+    shared_prefix_tokens = len(sharers) * s["prefix_len"]
+    skipped_fraction = (cached["prefix_hit_tokens"]
+                        / max(1, shared_prefix_tokens))
+    return {
+        "suite": "serving-prefix",
+        "metric": "prefix-cache-on tokens/s over prefix-cache-off "
+                  "tokens/s (same shared-prefix Poisson trace, same "
+                  "engine geometry and KV-HBM budget)",
+        "settings": {k: v for k, v in s.items()},
+        "shared_requests": len(sharers),
+        "shared_prefix_tokens": shared_prefix_tokens,
+        "cached": cached,
+        "uncached": uncached,
+        "ratio": cached["tokens_per_s"] / uncached["tokens_per_s"],
+        "ttft_p50_ratio": uncached["ttft_s"]["p50"]
+        / max(1e-9, cached["ttft_s"]["p50"]),
+        "prefix_tokens_skipped_fraction": skipped_fraction,
+        "recompiles_after_warmup": recompiles,
+        "platform": jax.default_backend(),
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--smoke", action="store_true",
                         help="seconds-fast tiny-model CPU path")
+    parser.add_argument("--shared-prefix", action="store_true",
+                        help="prefix-cache on/off comparison on a "
+                             "shared-prefix trace")
     parser.add_argument("--json", help="write the result JSON here too")
     args = parser.parse_args()
-    result = run_bench(smoke_settings() if args.smoke else default_settings())
+    if args.shared_prefix:
+        result = run_shared_bench(
+            shared_smoke_settings() if args.smoke else shared_settings())
+    else:
+        result = run_bench(
+            smoke_settings() if args.smoke else default_settings())
     text = json.dumps(result, indent=2)
     print(text)
     if args.json:
         with open(args.json, "w") as f:
             f.write(text + "\n")
     ratio = result["ratio"]
-    print(f"\ncontinuous/run-to-completion tokens/s ratio: {ratio:.3f} "
-          f"(target >= 1.5 on the full workload)", file=sys.stderr)
+    if args.shared_prefix:
+        print(f"\nprefix-cache on/off tokens/s ratio: {ratio:.3f} "
+              f"(target >= 1.3 on the full workload); "
+              f"{100 * result['prefix_tokens_skipped_fraction']:.1f}% of "
+              f"shared-prefix tokens skipped (target >= 50%)",
+              file=sys.stderr)
+    else:
+        print(f"\ncontinuous/run-to-completion tokens/s ratio: {ratio:.3f} "
+              f"(target >= 1.5 on the full workload)", file=sys.stderr)
 
 
 if __name__ == "__main__":

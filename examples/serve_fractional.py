@@ -121,14 +121,20 @@ def main() -> None:
         print(f"compiled steps: {warm_counts}")
 
         print("=== 4. requests: 8 mixed-length prompts through 4 slots ===")
+        # half the prompts open with one shared 24-token prefix (the
+        # system-prompt traffic shape) so the radix prefix cache has
+        # something to hit once early sharers retire
         rng = np.random.default_rng(0)
+        shared_prefix = rng.integers(0, config.vocab_size, 24)
         requests = []
         for i in range(8):
             prompt_len = int(rng.integers(12, 97))
             max_new = int(rng.integers(8, 49))
-            requests.append(Request(
-                f"req{i}", rng.integers(0, config.vocab_size, prompt_len),
-                max_new))
+            prompt = rng.integers(0, config.vocab_size, prompt_len)
+            if i % 2:
+                prompt = np.concatenate([shared_prefix, prompt[24:]]) \
+                    if prompt_len > 24 else prompt
+            requests.append(Request(f"req{i}", prompt, max_new))
             engine.submit(requests[-1])
         start = time.monotonic()
         results = engine.run()
@@ -149,6 +155,10 @@ def main() -> None:
               f"{engine.allocator.num_blocks - 1}; "
               f"recompiles after warmup: {recompiles} "
               f"({end_counts} vs {warm_counts})")
+        print(f"prefix cache: {engine.prefix_hit_requests} hit requests, "
+              f"{engine.prefix_hit_tokens} prompt tokens skipped, "
+              f"{engine.cow_copies} CoW copies, "
+              f"{engine.allocator.cached_idle_blocks} blocks cached idle")
         if recompiles:
             raise RuntimeError(
                 f"{recompiles} recompilations after warmup — static-shape "

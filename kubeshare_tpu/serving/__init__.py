@@ -15,23 +15,35 @@ The serving subsystem the fractional-chip runtime was built to host:
   into freed slots mid-flight, interleaving chunked prefill with batched
   decode, retiring slots on EOS/max-tokens and recycling their blocks —
   zero recompilation after warmup, every dispatch chargeable through the
-  :class:`~kubeshare_tpu.isolation.ExecutionGuard` token path.
+  :class:`~kubeshare_tpu.isolation.ExecutionGuard` token path;
+- :mod:`prefix_index` — the radix-tree prefix cache over the pool:
+  retired prompts' blocks become content-addressable, admission maps
+  matched blocks straight into a new slot's page table (refcounted
+  sharing, copy-on-write on mid-block divergence) and prefill starts at
+  the first uncached token; unreferenced cached blocks park in an LRU
+  pool drained only when a reservation would otherwise fail.
 """
 
-from .engine import EngineConfig, Request, RequestResult, ServingEngine
+from .engine import (EngineConfig, Request, RequestResult, ServingEngine,
+                     plan_prefill_chunks)
 from .kv_blocks import BlockExhausted, BlockAllocator, PagedKVPool, init_paged_pool
-from .paged import paged_decode_step, paged_gather_kv, paged_prefill_step
+from .paged import (paged_copy_block, paged_decode_step, paged_gather_kv,
+                    paged_prefill_step)
+from .prefix_index import PrefixIndex
 
 __all__ = [
     "BlockAllocator",
     "BlockExhausted",
     "EngineConfig",
     "PagedKVPool",
+    "PrefixIndex",
     "Request",
     "RequestResult",
     "ServingEngine",
     "init_paged_pool",
+    "paged_copy_block",
     "paged_decode_step",
     "paged_gather_kv",
     "paged_prefill_step",
+    "plan_prefill_chunks",
 ]

@@ -22,8 +22,19 @@ schedules at TOKEN granularity instead:
   (a lax.scan of step-identical iterations; lanes self-deactivate on
   budget/EOS) — dispatch overhead amortized the way the PyGraph line of
   work batches GPU launches;
-- slots retire on EOS / max-tokens; their blocks go back to the
-  free list and the next queued request takes them over.
+- slots retire on EOS / max-tokens; their blocks drop their reference
+  and the next queued request takes them over;
+- a radix-tree PREFIX CACHE (prefix_index.py) makes retired prompts'
+  blocks content-addressable: admission walks the new prompt down the
+  trie, maps every matched block into the slot's page table (refcount
+  +1 per reader — shared system prompts are stored ONCE), and starts
+  prefill at the first uncached token.  A prompt diverging mid-block
+  gets a copy-on-write private copy of the shared tail block before it
+  appends.  Retired blocks park in an idle-cached LRU pool instead of
+  freeing eagerly; eviction drains it only when a reservation would
+  otherwise fail (kv_blocks.py) — so the cache uses exactly the HBM
+  admission doesn't need, and the emitted streams stay bit-exact with
+  the cache disabled (test-locked, like every other engine property).
 
 Everything device-side is static-shaped — slot count, block tables,
 chunk widths — so after one warmup pass NOTHING recompiles
@@ -50,36 +61,51 @@ import numpy as np
 
 from ..models.decoding import _filter_logits, bucket_width
 from ..models.transformer import TransformerConfig
+from ..utils.promtext import (MetricFamily, MetricServer, Sample,
+                              _format_value)
 from .kv_blocks import BlockAllocator, BlockExhausted, init_paged_pool
-from .paged import paged_decode_step, paged_prefill_step
+from .paged import paged_copy_block, paged_decode_step, paged_prefill_step
+from .prefix_index import PrefixIndex
+
+# TTFT histogram bucket upper bounds (seconds) for the metrics endpoint
+# — spans sub-chunk CPU smoke latencies up to badly queued tail requests.
+TTFT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                10.0)
 
 
 def plan_prefill_chunks(
-    prompt_len: int, chunk: int, max_len: int
+    prompt_len: int, chunk: int, max_len: int, start: int = 0
 ) -> Tuple[List[Tuple[int, int, int]], int]:
     """Split a prompt into (start, width, last_row) chunks of bucketed
     widths; returns (plan, cover) where ``cover`` is the highest cache
     row the plan writes + 1 (never past ``max_len``, the slot's row
     bound — a short pool must not pad past the rows a request may own).
 
-    Full-width chunks tile the prompt's prefix; the ragged tail becomes
-    ONE bucketed chunk that ENDS exactly at the prompt's last token by
-    sliding its start back over already-written positions (recomputing
-    identical K/V — deterministic, so overwrite == no-op).  Only a
-    prompt shorter than its own bucket pads forward; its pad rows are
-    dead (outputs discarded, K/V overwritten by decode's write-then-
-    attend order before any causal band reaches them).
+    ``start`` is the first token that actually needs prefilling (the
+    prefix cache's match length, 0 when cold): full-width chunks tile
+    ``start ..``; the ragged tail becomes ONE bucketed chunk that ENDS
+    exactly at the prompt's last token by sliding its start back over
+    already-written positions — possibly below ``start``, into cached
+    rows: the recompute is deterministic, so the overwrite == no-op
+    (identical tokens at identical positions yield identical K/V).
+    Only a prompt shorter than its own bucket pads forward from 0; its
+    pad rows are dead (outputs discarded, K/V overwritten by decode's
+    write-then-attend order before any causal band reaches them).
     """
-    n, r = divmod(prompt_len, chunk)
-    plan = [(i * chunk, chunk, chunk - 1) for i in range(n)]
-    cover = n * chunk
+    if not 0 <= start < prompt_len:
+        raise ValueError(
+            f"start {start} not in 0..{prompt_len - 1} (at least one "
+            f"prompt token must prefill to produce first-token logits)")
+    n, r = divmod(prompt_len - start, chunk)
+    plan = [(start + i * chunk, chunk, chunk - 1) for i in range(n)]
+    cover = start + n * chunk
     if r:
         width = min(bucket_width(r, chunk), max_len)
         if prompt_len >= width:
             plan.append((prompt_len - width, width, width - 1))
             cover = prompt_len
-        else:  # n == 0: pad the tail; logits row is the last REAL token
-            plan = [(0, width, prompt_len - 1)]
+        else:  # whole prompt under its bucket: pad the tail; logits row
+            plan = [(0, width, prompt_len - 1)]  # is the last REAL token
             cover = width
     return plan, cover
 
@@ -108,6 +134,12 @@ class EngineConfig:
     # request; the filter set is part of the compiled step)
     top_k: Optional[int] = None
     top_p: Optional[float] = None
+    # radix-tree prefix caching over the block pool: retired prompts'
+    # blocks are indexed and shared with later requests (refcounted,
+    # copy-on-write on mid-block divergence, LRU-evicted only when a
+    # reservation would otherwise fail).  Output is bit-exact either
+    # way; False buys back nothing but is the bench's control arm.
+    prefix_cache: bool = True
 
 
 @dataclass
@@ -204,18 +236,31 @@ class ServingEngine:
         self.engine_config = ec
         self.guard = guard
         self.pool = init_paged_pool(config, ec.num_blocks, ec.block_size)
-        self.allocator = BlockAllocator(ec.num_blocks, ec.block_size)
+        self.prefix_index = (PrefixIndex(ec.block_size)
+                             if ec.prefix_cache else None)
+        self.allocator = BlockAllocator(
+            ec.num_blocks, ec.block_size,
+            evictor=(self.prefix_index.evict if self.prefix_index is not None
+                     else None))
         self._table_width = -(-ec.max_request_len // ec.block_size)
         self._slots = [_Slot(i, self._table_width)
                        for i in range(ec.num_slots)]
-        # (request, prefill plan, blocks needed) — computed once at submit
+        # (request, prefill plan, blocks needed) — computed once at
+        # submit; _admit re-plans only on a prefix-cache hit
         self._queue: Deque[Tuple[Request, List[Tuple[int, int, int]], int]] = deque()
         self._results: Dict[str, RequestResult] = {}
-        # counters (the bench's raw material)
+        # counters (the bench's and the metrics endpoint's raw material)
         self.decode_steps = 0
         self.prefill_chunks = 0
         self.tokens_generated = 0
         self.peak_blocks_in_use = 0
+        self.requests_admitted = 0
+        self.requests_finished = 0
+        self.prefix_hit_requests = 0
+        self.prefix_hit_tokens = 0  # prompt tokens whose prefill was skipped
+        self.cow_copies = 0
+        self._ttft_counts = [0] * (len(TTFT_BUCKETS) + 1)  # +Inf tail
+        self._ttft_sum = 0.0
 
         cfg = config
         top_k, top_p = ec.top_k, ec.top_p
@@ -276,6 +321,15 @@ class ServingEngine:
             return emitted, pk, pv  # emitted [span, S]
 
         self._decode_step = jax.jit(decode, donate_argnums=(1, 2))
+        # the copy-on-write primitive: one block, all layers, K and V —
+        # a single static shape, so the cache adds exactly ONE compile.
+        # Wrapped per-engine (like prefill/decode above): jitting the
+        # module-level function directly would share one jit cache
+        # across engines with different pool shapes.
+        def copy(pk, pv, src, dst):
+            return paged_copy_block(pk, pv, src, dst)
+
+        self._copy_step = jax.jit(copy, donate_argnums=(0, 1))
 
     # ------------------------------------------------------------------
     # public API
@@ -407,7 +461,12 @@ class ServingEngine:
             jnp.zeros((s,), jnp.float32),
             jnp.zeros((s, ec.decode_span, 2), jnp.uint32), zeros_s)
         self.pool = replace(self.pool, k=pk, v=pv)
-        jax.block_until_ready(pk)
+        if self.prefix_index is not None:
+            # the CoW copy's one shape; scratch -> scratch is a no-op
+            zero = jnp.zeros((), jnp.int32)
+            pk, pv = self._copy_step(self.pool.k, self.pool.v, zero, zero)
+            self.pool = replace(self.pool, k=pk, v=pv)
+        jax.block_until_ready(self.pool.k)
 
     def compile_counts(self) -> Dict[str, int]:
         """Jit cache sizes per step function — the zero-recompile
@@ -415,32 +474,177 @@ class ServingEngine:
         return {
             "decode": self._decode_step._cache_size(),
             "prefill": self._prefill_step._cache_size(),
+            "copy": self._copy_step._cache_size(),
         }
+
+    # ------------------------------------------------------------------
+    # metrics (the collector-plane scrape surface)
+    # ------------------------------------------------------------------
+    def collect_metrics(self) -> List[MetricFamily]:
+        """Serving-plane runtime metrics in the same exposition format
+        the token daemons and the chip collector speak
+        (``utils/promtext``) — a stock Prometheus scrapes the serving
+        pod exactly like it scrapes ``gpu_capacity``."""
+        req = MetricFamily(
+            "kubeshare_serving_requests_total",
+            "Requests by lifecycle stage.", "counter")
+        req.add({"stage": "admitted"}, self.requests_admitted)
+        req.add({"stage": "finished"}, self.requests_finished)
+        blocks = MetricFamily(
+            "kubeshare_serving_kv_blocks",
+            "KV pool blocks by state (in_use counts refcounted blocks; "
+            "cached are idle prefix-cache blocks, evictable on demand).",
+            "gauge")
+        blocks.add({"state": "in_use"}, self.allocator.blocks_in_use)
+        blocks.add({"state": "free"}, self.allocator.free_blocks)
+        blocks.add({"state": "cached"}, self.allocator.cached_idle_blocks)
+        tokens = MetricFamily(
+            "kubeshare_serving_tokens_generated_total",
+            "Tokens emitted across all requests.", "counter")
+        tokens.add({}, self.tokens_generated)
+        dispatches = MetricFamily(
+            "kubeshare_serving_dispatches_total",
+            "Device dispatches by kind.", "counter")
+        dispatches.add({"kind": "prefill_chunk"}, self.prefill_chunks)
+        dispatches.add({"kind": "decode_span"}, self.decode_steps)
+        dispatches.add({"kind": "cow_copy"}, self.cow_copies)
+        prefix = MetricFamily(
+            "kubeshare_serving_prefix_cache_requests_total",
+            "Admitted requests by prefix-cache outcome.", "counter")
+        hits = self.prefix_hit_requests
+        prefix.add({"result": "hit"}, hits)
+        prefix.add({"result": "miss"}, self.requests_admitted - hits)
+        hit_tokens = MetricFamily(
+            "kubeshare_serving_prefix_hit_tokens_total",
+            "Prompt tokens whose prefill was skipped via the prefix "
+            "cache.", "counter")
+        hit_tokens.add({}, self.prefix_hit_tokens)
+        evicted = MetricFamily(
+            "kubeshare_serving_prefix_evicted_blocks_total",
+            "Cached blocks evicted to fund reservations.", "counter")
+        evicted.add({}, self.allocator.evicted_blocks)
+        ttft = MetricFamily(
+            "kubeshare_serving_ttft_seconds",
+            "Time to first token (submit to first emitted token).",
+            "histogram")
+        cum = 0
+        for le, count in zip(TTFT_BUCKETS, self._ttft_counts):
+            cum += count
+            ttft.samples.append(Sample(
+                "kubeshare_serving_ttft_seconds_bucket",
+                {"le": _format_value(le)}, cum))
+        cum += self._ttft_counts[-1]
+        ttft.samples.append(Sample(
+            "kubeshare_serving_ttft_seconds_bucket", {"le": "+Inf"}, cum))
+        ttft.samples.append(Sample(
+            "kubeshare_serving_ttft_seconds_sum", {}, self._ttft_sum))
+        ttft.samples.append(Sample(
+            "kubeshare_serving_ttft_seconds_count", {}, cum))
+        return [req, blocks, tokens, dispatches, prefix, hit_tokens,
+                evicted, ttft]
+
+    def serve_metrics(self, port: int = 0) -> MetricServer:
+        """Start the textfile HTTP scrape endpoint (``/metrics`` and
+        ``/kubeshare-serving``); returns the started server (its
+        ``.port`` is the bound port — pass 0 for ephemeral)."""
+        server = MetricServer(self.collect_metrics, port=port,
+                              path="/kubeshare-serving")
+        server.start()
+        return server
 
     # ------------------------------------------------------------------
     # internals
     # ------------------------------------------------------------------
+    def _observe_ttft(self, seconds: float) -> None:
+        self._ttft_sum += seconds
+        for i, le in enumerate(TTFT_BUCKETS):
+            if seconds <= le:
+                self._ttft_counts[i] += 1
+                return
+        self._ttft_counts[-1] += 1
+    def _match_prefix(self, req: Request) -> Tuple[int, List[int], Optional[int], List[Tuple[int, int, int]], int]:
+        """Admission-time prefix lookup for one queued request: returns
+        (start, shared_blocks, cow_src, plan, fresh_needed).  ``start``
+        is the first token that must prefill (0 = cold); ``shared``
+        are fully reused blocks mapped into the slot's table for the
+        request's lifetime; ``cow_src`` is the partially matched block
+        to copy-on-write (None when the match ends on a block
+        boundary).  The matched-token cap (prompt - 1) keeps at least
+        one real token in the prefill plan — its logits row IS the
+        first output token."""
+        ec = self.engine_config
+        prompt = req.prompt
+        matched, mblocks = self.prefix_index.match(prompt)
+        matched = min(matched, prompt.size - 1)
+        if matched <= 0:
+            return 0, [], None, [], 0
+        mblocks = mblocks[: self.allocator.blocks_for_tokens(matched)]
+        n_keep = matched // ec.block_size
+        cow_src = mblocks[n_keep] if matched % ec.block_size else None
+        plan, cover = plan_prefill_chunks(
+            prompt.size, ec.prefill_chunk, ec.max_request_len, matched)
+        total_rows = max(cover, prompt.size + req.max_new_tokens)
+        fresh = self.allocator.blocks_for_tokens(total_rows) - n_keep
+        return matched, mblocks[:n_keep], cow_src, plan, fresh
+
     def _admit(self) -> None:
         """FIFO admission: pop queued requests into free slots while the
         allocator can fund them.  Head-of-line blocking is deliberate —
-        skipping ahead would starve large requests forever."""
+        skipping ahead would starve large requests forever.
+
+        With the prefix cache, admission first walks the prompt down the
+        radix index and RETAINS every matched block (refcount +1 — a
+        retained block cannot be evicted by the reservation that
+        follows), then reserves only the blocks the uncached suffix
+        needs.  A partially matched tail block is copied-on-write into
+        the first fresh block before the slot may append to it."""
         while self._queue:
             free = [s for s in self._slots if s.state == "free"]
             if not free:
                 return
             req, plan, needed = self._queue[0]
+            start, shared, cow_src, hit_plan, hit_needed = 0, [], None, [], 0
+            if self.prefix_index is not None:
+                start, shared, cow_src, hit_plan, hit_needed = \
+                    self._match_prefix(req)
+            if start:
+                plan, needed = hit_plan, hit_needed
+            retained = shared + ([cow_src] if cow_src is not None else [])
+            if retained:
+                self.allocator.retain(retained)
             try:
                 blocks = self.allocator.reserve(needed, req.rid)
             except BlockExhausted:
+                if retained:
+                    self.allocator.reclaim(retained)
                 return  # stays queued; retirement will free blocks
             self._queue.popleft()
             slot = free[0]
             slot.state = "prefill"
             slot.rid = req.rid
-            slot.blocks = blocks
+            # table order: [shared prefix blocks | CoW copy (blocks[0],
+            # when the match ends mid-block) | fresh suffix blocks]
+            slot.blocks = shared + blocks
             slot.table[:] = 0
-            slot.table[: len(blocks)] = blocks
+            slot.table[: len(slot.blocks)] = slot.blocks
             slot.length = 0
+            if cow_src is not None:
+                pk, pv = self._dispatch(
+                    self._copy_step, self.pool.k, self.pool.v,
+                    jnp.asarray(cow_src, jnp.int32),
+                    jnp.asarray(blocks[0], jnp.int32))
+                self.pool = replace(self.pool, k=pk, v=pv)
+                self.allocator.reclaim([cow_src])  # transient read ref
+                self.cow_copies += 1
+            if start:
+                # honest skip count: the bucketed tail may slide BELOW
+                # the match point (or a tiny prompt replans from 0),
+                # re-prefilling cached rows — only rows no plan chunk
+                # rewrites were actually skipped
+                skipped = min(start, min(s for s, _, _ in plan))
+                self.prefix_hit_requests += 1
+                self.prefix_hit_tokens += skipped
+            self.requests_admitted += 1
             slot.generated = []
             slot.prompt = req.prompt
             slot.plan = list(plan)
@@ -507,6 +711,7 @@ class ServingEngine:
         slot.length = slot.prompt.size
         slot.generated = [first]
         slot.result.first_token_at = time.monotonic()
+        self._observe_ttft(slot.result.ttft)
         self.tokens_generated += 1
         slot.state = "decode"
         self._maybe_retire(slot, first)
@@ -565,6 +770,24 @@ class ServingEngine:
             result = slot.result
             result.tokens = list(slot.generated)
             result.finished_at = time.monotonic()
+            if self.prefix_index is not None:
+                # index the prompt's blocks BEFORE dropping our refs:
+                # insertion routes them to the idle-cached pool instead
+                # of the free list (blocks past the prompt — pure decode
+                # rows — free normally).  Blocks the trie already held
+                # under identical tokens are simply not re-referenced;
+                # a displaced block (our longer tail upgrading an
+                # existing partial leaf) is uncached so its last reader
+                # frees it.
+                n_prompt = self.allocator.blocks_for_tokens(
+                    slot.prompt.size)
+                prompt_blocks = [int(b) for b in slot.table[:n_prompt]]
+                newly_cached, displaced = self.prefix_index.insert(
+                    slot.prompt, prompt_blocks)
+                self.allocator.mark_cached(newly_cached)
+                for b in displaced:
+                    self.allocator.uncache(b)
             self.allocator.reclaim(slot.blocks)
+            self.requests_finished += 1
             slot._clear()
             slot.state = "free"

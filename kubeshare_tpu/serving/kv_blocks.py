@@ -26,8 +26,9 @@ result must land nowhere).  The allocator never hands block 0 out.
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 from dataclasses import dataclass
-from typing import Dict, List
+from typing import Callable, Dict, List, Optional, Sequence, Set
 
 import jax.numpy as jnp
 
@@ -87,14 +88,34 @@ def init_paged_pool(
 
 
 class BlockAllocator:
-    """Free-list allocator over pool block ids (host-side, O(1) ops).
+    """Refcounted free-list allocator over pool block ids (host-side).
 
-    LIFO reuse: the blocks a retired request returns are the first
-    handed to the next admission — the hot end of the pool stays hot,
-    and the recycle tests can watch reuse happen.
+    A block is in exactly one of three states:
+
+    - **free** — on the free list, immediately reservable (LIFO reuse:
+      the blocks a retired request returns are the first handed to the
+      next admission — the hot end of the pool stays hot);
+    - **in use** — refcount >= 1.  With the prefix cache, a SHARED
+      prefix block is referenced by every slot whose page table maps it
+      (``retain``/``reclaim`` move the count); a block is never handed
+      back out while anyone still reads it;
+    - **idle-cached** — refcount 0 but still referenced by the prefix
+      index.  These sit in an LRU pool (``cached_idle_blocks``) that
+      eviction drains ONLY when ``reserve`` would otherwise raise
+      :class:`BlockExhausted` — the cache uses exactly the HBM that
+      admission doesn't need, and gives it back the moment it does.
+
+    Eviction is delegated to ``evictor`` (the prefix index): it must
+    detach the victim from the trie and return every block released
+    (the victim's whole subtree — an idle parent's descendants are idle
+    too, because every reader retains the full chain).  The allocator
+    verifies each returned block really was idle-cached; a live block
+    coming back from the evictor is a corruption, not a policy choice.
     """
 
-    def __init__(self, num_blocks: int, block_size: int) -> None:
+    def __init__(self, num_blocks: int, block_size: int,
+                 evictor: Optional[Callable[[int], List[int]]] = None
+                 ) -> None:
         if num_blocks < 2:
             raise ValueError(
                 f"num_blocks must be >= 2 (block 0 is reserved scratch), "
@@ -102,9 +123,14 @@ class BlockAllocator:
             )
         self.num_blocks = num_blocks
         self.block_size = block_size
+        self.evictor = evictor
         # block 0 reserved; free list popped from the tail (LIFO)
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
-        self._owner: Dict[int, str] = {}  # block id -> request id
+        self._refs: Dict[int, int] = {}  # block id -> reference count
+        self._cached: Set[int] = set()  # blocks the prefix index holds
+        # refcount-0 cached blocks, least recently released first
+        self._idle: "OrderedDict[int, None]" = OrderedDict()
+        self.evicted_blocks = 0  # lifetime eviction counter (metrics)
         self._lock = threading.Lock()
 
     @property
@@ -115,7 +141,18 @@ class BlockAllocator:
     @property
     def blocks_in_use(self) -> int:
         with self._lock:
-            return len(self._owner)
+            return len(self._refs)
+
+    @property
+    def cached_idle_blocks(self) -> int:
+        with self._lock:
+            return len(self._idle)
+
+    @property
+    def available_blocks(self) -> int:
+        """What a reservation can draw on: free now + evictable cache."""
+        with self._lock:
+            return len(self._free) + len(self._idle)
 
     def blocks_for_tokens(self, tokens: int) -> int:
         """How many blocks cover ``tokens`` cache rows."""
@@ -127,33 +164,106 @@ class BlockAllocator:
         All-or-nothing: a partial grant would leave a request half-
         admitted with no block for its next token — exactly the silent
         clamp-overwrite failure mode the dense cache's headroom checks
-        exist to prevent.
+        exist to prevent.  When the free list alone cannot fund the
+        reservation, idle-cached blocks are evicted LRU-first (whole
+        subtrees — see class docstring); only a shortfall that survives
+        a fully drained cache raises.
         """
         if count < 1:
             raise ValueError(f"count must be >= 1, got {count}")
         with self._lock:
-            if count > len(self._free):
+            if count > len(self._free) + len(self._idle):
+                # doomed even after a full drain (eviction conserves
+                # free + idle) — raise WITHOUT wiping the cache, or a
+                # too-big head-of-line request would pin the prefix
+                # cache at zero for its whole wait
                 raise BlockExhausted(
                     f"request {owner!r} needs {count} blocks but only "
                     f"{len(self._free)} of {self.num_blocks - 1} are free "
-                    f"(block_size {self.block_size})"
+                    f"({len(self._idle)} more evictable; block_size "
+                    f"{self.block_size})"
                 )
+            while count > len(self._free) and self._idle:
+                victim = next(iter(self._idle))
+                removed = (self.evictor(victim) if self.evictor is not None
+                           else [victim])
+                if victim not in removed:
+                    raise RuntimeError(
+                        f"evictor did not release victim block {victim}")
+                for b in removed:
+                    if b in self._refs or b not in self._idle:
+                        raise RuntimeError(
+                            f"evictor released block {b}, which is not "
+                            f"idle-cached (refcount "
+                            f"{self._refs.get(b, 0)}) — index/allocator "
+                            f"state diverged")
+                    del self._idle[b]
+                    self._cached.discard(b)
+                    self._free.append(b)
+                    self.evicted_blocks += 1
+            # the up-front doomed-check plus the drain loop guarantee
+            # the free list can now fund the reservation (eviction
+            # conserves free + idle)
             blocks = [self._free.pop() for _ in range(count)]
             for b in blocks:
-                self._owner[b] = owner
+                self._refs[b] = 1
             return blocks
 
-    def reclaim(self, blocks: List[int]) -> None:
-        """Return a retired request's blocks to the free list.  Double
-        frees and foreign ids raise — a corrupted table must never
-        silently donate another request's live blocks."""
+    def retain(self, blocks: Sequence[int]) -> None:
+        """Add one reference per block — a prefix-cache hit mapping
+        cached blocks into a new slot's page table.  Retaining an
+        idle-cached block pulls it out of the eviction pool."""
         with self._lock:
             for b in blocks:
-                if b not in self._owner:
+                if b in self._refs:
+                    self._refs[b] += 1
+                elif b in self._idle:
+                    del self._idle[b]
+                    self._refs[b] = 1
+                else:
+                    raise ValueError(
+                        f"block {b} is neither in use nor cached — "
+                        f"cannot retain (stale match?)")
+
+    def reclaim(self, blocks: Sequence[int]) -> None:
+        """Drop one reference per block.  At refcount 0 a block goes
+        back to the free list — unless the prefix index still holds it,
+        in which case it parks in the idle-cached LRU pool (most
+        recently released last, so eviction drains the coldest prefix
+        first).  Double frees and foreign ids raise — a corrupted table
+        must never silently donate another request's live blocks."""
+        with self._lock:
+            for b in blocks:
+                if b not in self._refs:
                     raise ValueError(
                         f"block {b} is not allocated (double free, or a "
                         f"corrupted block table)"
                     )
             for b in blocks:
-                del self._owner[b]
-                self._free.append(b)
+                self._refs[b] -= 1
+                if self._refs[b] == 0:
+                    del self._refs[b]
+                    if b in self._cached:
+                        self._idle[b] = None
+                    else:
+                        self._free.append(b)
+
+    def mark_cached(self, blocks: Sequence[int]) -> None:
+        """The prefix index now references these blocks (retirement
+        insertion); at refcount 0 they park instead of freeing."""
+        with self._lock:
+            for b in blocks:
+                if b not in self._refs and b not in self._idle:
+                    raise ValueError(
+                        f"block {b} is not live — cannot mark cached")
+                self._cached.add(b)
+
+    def uncache(self, block: int) -> None:
+        """The prefix index dropped this block (a displaced upgrade).
+        An idle block frees immediately; an in-use block frees at its
+        last reclaim."""
+        with self._lock:
+            self._cached.discard(block)
+            if block in self._idle:
+                del self._idle[block]
+                self._free.append(block)

@@ -58,6 +58,25 @@ def paged_gather_kv(pool_k, pool_v, block_table):
     return view(pool_k), view(pool_v)
 
 
+def paged_copy_block(pool_k, pool_v, src, dst):
+    """Copy ONE block's rows (all layers, K and V) ``src`` -> ``dst`` —
+    the prefix cache's copy-on-write primitive.
+
+    A partially filled cached block cannot be appended to in place: its
+    tail rows are shared state (other slots read them; the trie indexes
+    them), so a request whose prompt diverges mid-block gets a private
+    copy and writes there.  ``src``/``dst`` ride as TRACED scalars, so
+    the jitted copy compiles exactly once (block shape is static) —
+    warmup covers it and the zero-recompile property holds with the
+    cache enabled.  All ``block_size`` rows are copied: rows past the
+    matched prefix are stale, but prefill overwrites them before any
+    causal band can reach them (the same write-then-attend order that
+    makes pad rows dead in the chunked prefill).
+    """
+    return (pool_k.at[:, dst].set(pool_k[:, src]),
+            pool_v.at[:, dst].set(pool_v[:, src]))
+
+
 def _layer_views(pk_layer, pv_layer, tables, config: TransformerConfig):
     """Per-lane virtual K/V views for ONE layer: pool [B, h_kv, bs, d]
     gathered through lane tables [P, T] -> [P, h_kv, T*bs, d].  The one

@@ -1,0 +1,235 @@
+"""Radix-tree prefix index over the paged KV pool, at block granularity.
+
+Two requests sharing a 1,000-token system prompt burn identical prefill
+FLOPs and identical KV blocks under PR 1's engine — the redundancy
+RadixAttention (SGLang) and vLLM-style paged sharing eliminate.  This
+index makes the pool's blocks CONTENT-addressable: a trie whose edges
+are token runs of at most ``block_size`` tokens, each node owning the
+pool block that holds exactly those tokens' K/V rows.  At admission the
+engine walks a new prompt down the trie, maps every matched block into
+the slot's page table, and starts prefill at the first uncached token;
+at retirement it inserts the request's prompt blocks so the NEXT
+request can match them.
+
+Granularity rules (all host-side; a lookup walks O(prompt/block_size)
+dict hops plus one tail scan bounded by the children sharing the tail's
+first token):
+
+- interior nodes are FULL blocks (``block_size`` tokens) and are the
+  only nodes with children — a child's K/V is only valid on top of a
+  completely cached prefix;
+- a partially filled tail block is a LEAF (``filled < block_size``); it
+  can be *upgraded* in place when a longer retiree extends it (the old
+  block is displaced — the caller uncaches it);
+- matching may stop MID-node: a prompt that diverges inside a block
+  matches the longest common prefix of the node's tokens and shares
+  only those rows — the engine copy-on-writes the block before the
+  diverging request appends to it (kv_blocks / engine own that rule;
+  the index only reports how many tokens matched).
+
+The index holds NO refcounts and never talks to the device: block
+lifetime is the allocator's job (``BlockAllocator`` refcounts,
+idle-cached LRU), eviction is driven by the allocator calling
+:meth:`evict` when ``reserve`` would otherwise raise — the index
+detaches the victim's node AND its whole subtree (an idle parent's
+descendants are idle too: every matcher retains the full chain, so a
+child can never outlive its parent's last reference).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def _lcp(a: Sequence[int], b: Sequence[int]) -> int:
+    n = min(len(a), len(b))
+    i = 0
+    while i < n and a[i] == b[i]:
+        i += 1
+    return i
+
+
+class _Node:
+    __slots__ = ("tokens", "block", "parent", "children", "partials")
+
+    def __init__(self, tokens: Tuple[int, ...], block: int,
+                 parent: Optional["_Node"]) -> None:
+        self.tokens = tokens
+        self.block = block
+        self.parent = parent
+        # full-block children keyed by their exact token tuple
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        # partially-filled leaf children (filled < block_size)
+        self.partials: List["_Node"] = []
+
+
+class PrefixIndex:
+    """The trie.  All methods take prompts as int sequences (numpy
+    arrays welcome) and return pool block ids; the caller (engine) is
+    responsible for refcounting matched blocks BEFORE anything that
+    could evict, and for the matched-tokens cap (at least one prompt
+    token must prefill to produce first-token logits)."""
+
+    def __init__(self, block_size: int) -> None:
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.block_size = block_size
+        self._root = _Node((), -1, None)
+        self._by_block: Dict[int, _Node] = {}
+
+    @property
+    def cached_blocks(self) -> int:
+        return len(self._by_block)
+
+    @property
+    def cached_tokens(self) -> int:
+        return sum(len(n.tokens) for n in self._by_block.values())
+
+    # ------------------------------------------------------------------
+    def match(self, tokens) -> Tuple[int, List[int]]:
+        """Longest cached prefix of ``tokens``: (matched_token_count,
+        blocks) where ``blocks[i]`` holds rows ``i*bs .. i*bs+bs-1`` and
+        the LAST block may be matched only partially
+        (``matched % block_size`` rows) — the engine's CoW trigger."""
+        bs = self.block_size
+        toks = [int(t) for t in tokens]
+        node = self._root
+        blocks: List[int] = []
+        pos = 0
+        while len(toks) - pos >= bs:
+            child = node.children.get(tuple(toks[pos: pos + bs]))
+            if child is None:
+                break
+            blocks.append(child.block)
+            pos += bs
+            node = child
+        # mid-block tail: the longest common prefix against any child —
+        # full children included (a prompt may diverge inside a cached
+        # full block and still reuse the rows before the divergence).
+        # Each child costs one O(1) first-token reject; only candidates
+        # sharing the tail's first token pay a token-by-token lcp.
+        rem = toks[pos:]
+        best, best_block = 0, -1
+        if rem:
+            for child in list(node.children.values()) + node.partials:
+                if child.tokens[0] != rem[0]:
+                    continue
+                l = _lcp(child.tokens, rem)
+                if l > best:
+                    best, best_block = l, child.block
+        if best:
+            blocks.append(best_block)
+            pos += best
+        return pos, blocks
+
+    # ------------------------------------------------------------------
+    def insert(self, tokens, blocks: Sequence[int]
+               ) -> Tuple[List[int], List[int]]:
+        """Insert a retired request's prompt chain: ``blocks[i]`` holds
+        ``tokens[i*bs:(i+1)*bs]`` (last possibly partial).  Returns
+        ``(newly_cached, displaced)``: blocks the trie now references
+        (caller must ``mark_cached``) and blocks it stopped referencing
+        (an upgraded partial's old block — caller must ``uncache``).
+        Blocks already present under identical tokens are simply not
+        referenced again (the caller's release routes them normally)."""
+        bs = self.block_size
+        toks = [int(t) for t in tokens]
+        n_blocks = -(-len(toks) // bs)
+        if n_blocks != len(blocks):
+            raise ValueError(
+                f"{len(toks)} tokens need {n_blocks} blocks, got "
+                f"{len(blocks)}")
+        node = self._root
+        newly_cached: List[int] = []
+        displaced: List[int] = []
+        for i, block in enumerate(blocks):
+            seg = tuple(toks[i * bs: (i + 1) * bs])
+            if len(seg) == bs:
+                child = node.children.get(seg)
+                if child is not None:  # already cached; ours is surplus
+                    node = child
+                    continue
+                # a partial leaf our full block extends: upgrade it in
+                # place (our block holds ALL bs rows; the old one only
+                # its filled prefix) — the trie deepens as traffic does
+                upgraded = None
+                for p in node.partials:
+                    if seg[: len(p.tokens)] == p.tokens:
+                        upgraded = p
+                        break
+                if upgraded is not None:
+                    node.partials.remove(upgraded)
+                    if upgraded.block != block:
+                        displaced.append(upgraded.block)
+                        self._by_block.pop(upgraded.block, None)
+                    upgraded.tokens = seg
+                    upgraded.block = block
+                    node.children[seg] = upgraded
+                    self._by_block[block] = upgraded
+                    newly_cached.append(block)
+                    node = upgraded
+                    continue
+                child = _Node(seg, block, node)
+                node.children[seg] = child
+                self._by_block[block] = child
+                newly_cached.append(block)
+                node = child
+            else:
+                # partial tail: covered / extendable / sibling.  A FULL
+                # child opening with our tokens also covers us — caching
+                # our shorter block beside it would pin HBM that match()
+                # (longest-lcp) could never prefer.
+                covered = extended = None
+                for c in node.children.values():
+                    if c.tokens[: len(seg)] == seg:
+                        covered = c
+                        break
+                for p in node.partials if covered is None else ():
+                    if len(p.tokens) >= len(seg) and \
+                            p.tokens[: len(seg)] == seg:
+                        covered = p
+                        break
+                    if len(p.tokens) < len(seg) and \
+                            seg[: len(p.tokens)] == p.tokens:
+                        extended = p
+                        break
+                if covered is not None:
+                    break  # existing leaf already holds (at least) ours
+                if extended is not None:
+                    if extended.block != block:
+                        displaced.append(extended.block)
+                        self._by_block.pop(extended.block, None)
+                    extended.tokens = seg
+                    extended.block = block
+                    self._by_block[block] = extended
+                    newly_cached.append(block)
+                else:
+                    child = _Node(seg, block, node)
+                    node.partials.append(child)
+                    self._by_block[block] = child
+                    newly_cached.append(block)
+        return newly_cached, displaced
+
+    # ------------------------------------------------------------------
+    def evict(self, block: int) -> List[int]:
+        """Detach the node holding ``block`` plus its whole subtree;
+        returns every block id released.  Called by the allocator's
+        reserve when the free list alone cannot fund a reservation —
+        cache memory is exactly the HBM admission doesn't need."""
+        node = self._by_block.get(block)
+        if node is None:
+            return []
+        parent = node.parent
+        if len(node.tokens) == self.block_size:
+            del parent.children[node.tokens]
+        else:
+            parent.partials.remove(node)
+        removed: List[int] = []
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            removed.append(n.block)
+            self._by_block.pop(n.block, None)
+            stack.extend(n.children.values())
+            stack.extend(n.partials)
+        return removed
