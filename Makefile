@@ -5,6 +5,7 @@
 #   make test            run the test suite (CPU mesh)
 #   make serve-smoke     continuous-batching serving bench, fast CPU path
 #   make serve-prefix-smoke  prefix-cache on/off serving bench, fast CPU path
+#   make serve-qos-smoke multi-tenant QoS serving bench, fast CPU path
 #   make images          build the kubeshare-tpu:latest container image
 #   make image-check     validate everything the Dockerfile needs, sans docker
 #   make e2e-kind        kind-based end-to-end (skips cleanly without kind)
@@ -12,7 +13,7 @@
 IMAGE ?= kubeshare-tpu:latest
 DOCKER ?= $(shell command -v docker || command -v podman)
 
-.PHONY: all native test serve-smoke serve-prefix-smoke images image-check e2e-kind tsan clean
+.PHONY: all native test serve-smoke serve-prefix-smoke serve-qos-smoke images image-check e2e-kind tsan clean
 
 all: native
 
@@ -30,6 +31,9 @@ serve-smoke:
 
 serve-prefix-smoke:
 	JAX_PLATFORMS=cpu python3 benchmarks/serving_bench.py --shared-prefix --smoke
+
+serve-qos-smoke:
+	JAX_PLATFORMS=cpu python3 benchmarks/serving_bench.py --multi-tenant --smoke
 
 images: image-check
 ifeq ($(strip $(DOCKER)),)

@@ -50,11 +50,26 @@ Methodology (the serving section of docs/perf.md records results):
 
 Run:
 
+- ``--multi-tenant`` switches to the QoS comparison: one merged trace
+  (a Guarantee tenant's paced stream + an Opportunistic flood arriving
+  at t~0) replayed three ways at the SAME KV-HBM budget — the Guarantee
+  trace alone (its entitled service), QoS on (class-priority fair
+  queue, flood block quota, cache-backed preemption), and QoS off (the
+  single-tenant FIFO engine).  Headline numbers: the Guarantee tenant's
+  tokens/s retention and TTFT p50 ratio vs isolated, aggregate
+  qos-on/qos-off tokens/s, preemption counts — and a hard assert that
+  every request's stream is bit-exact between qos-on and qos-off
+  (preempted requests resume through the prefix cache).
+
+Run:
+
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --smoke
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py            # full
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --shared-prefix
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --shared-prefix --smoke
-    make serve-smoke
+    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --multi-tenant
+    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --multi-tenant --smoke
+    make serve-smoke serve-prefix-smoke serve-qos-smoke
 """
 
 from __future__ import annotations
@@ -144,6 +159,80 @@ def shared_settings() -> dict:
     )
 
 
+def qos_smoke_settings() -> dict:
+    """Seconds-fast multi-tenant path (CI, tests/test_serving.py): a
+    Guarantee tenant's steady stream under an Opportunistic flood that
+    arrives all at once and would soak every slot and block FIFO."""
+    return dict(
+        d_model=128, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=256,
+        vocab_size=512, max_seq_len=96,
+        num_slots=4, block_size=8, num_blocks=49,  # 48 blocks = 384 rows
+        max_request_len=96, prefill_chunk=32,
+        g_requests=6, g_prompt_lo=8, g_prompt_hi=32,
+        g_new_lo=8, g_new_hi=16, g_mean_interarrival_s=0.02,
+        # long-decode flood: every slot a flood request grabs stays busy
+        # for dozens of spans, so Guarantee arrivals MUST preempt
+        o_requests=16, o_prompt_lo=8, o_prompt_hi=24,
+        o_new_lo=24, o_new_hi=48, o_mean_interarrival_s=0.001,
+        o_quota_blocks=40,  # enough to soak all slots, not the pool
+        seed=0,
+    )
+
+
+def qos_settings() -> dict:
+    """The multi-tenant capture configuration (acceptance shape): the
+    full-bench model, 12 Guarantee requests paced over the run, 36
+    Opportunistic requests flooding from t=0 at one shared KV-HBM
+    budget."""
+    return dict(
+        d_model=256, n_layers=4, n_heads=8, n_kv_heads=2, d_ff=1024,
+        vocab_size=4096, max_seq_len=320,
+        num_slots=12, block_size=16, num_blocks=161,  # 160 blocks
+        max_request_len=320, prefill_chunk=64,
+        g_requests=12, g_prompt_lo=16, g_prompt_hi=128,
+        g_new_lo=16, g_new_hi=64, g_mean_interarrival_s=0.25,
+        # long-decode flood (see qos_smoke_settings): slots stay soaked
+        o_requests=36, o_prompt_lo=16, o_prompt_hi=64,
+        o_new_lo=64, o_new_hi=96, o_mean_interarrival_s=0.002,
+        o_quota_blocks=120,  # enough to soak all slots, not the pool
+        seed=0,
+    )
+
+
+def build_qos_workload(s: dict):
+    """One merged trace of two tenants: ``prod`` (Guarantee, Poisson
+    paced) and ``batch`` (Opportunistic, near-simultaneous flood).
+    Returns (trace sorted by arrival, tenant_of)."""
+    rng = np.random.default_rng(s["seed"])
+    trace, tenant_of = [], {}
+    t = 0.0
+    for i in range(s["g_requests"]):
+        t += float(rng.exponential(s["g_mean_interarrival_s"]))
+        rid = f"g{i}"
+        prompt = rng.integers(
+            0, s["vocab_size"],
+            int(rng.integers(s["g_prompt_lo"], s["g_prompt_hi"] + 1))
+        ).astype(np.int32)
+        trace.append((rid, prompt,
+                      int(rng.integers(s["g_new_lo"], s["g_new_hi"] + 1)),
+                      t))
+        tenant_of[rid] = "prod"
+    t = 0.0
+    for i in range(s["o_requests"]):
+        t += float(rng.exponential(s["o_mean_interarrival_s"]))
+        rid = f"o{i}"
+        prompt = rng.integers(
+            0, s["vocab_size"],
+            int(rng.integers(s["o_prompt_lo"], s["o_prompt_hi"] + 1))
+        ).astype(np.int32)
+        trace.append((rid, prompt,
+                      int(rng.integers(s["o_new_lo"], s["o_new_hi"] + 1)),
+                      t))
+        tenant_of[rid] = "batch"
+    trace.sort(key=lambda entry: entry[3])
+    return trace, tenant_of
+
+
 def build_workload(s: dict):
     """One shared trace: (rid, prompt, max_new, arrival_offset_s)."""
     rng = np.random.default_rng(s["seed"])
@@ -193,13 +282,15 @@ def _percentiles(values, ps=(50, 95)):
 
 
 def run_continuous(params, config, s: dict, trace,
-                   prefix_cache: bool = True) -> dict:
+                   prefix_cache: bool = True, registry=None,
+                   tenant_of=None) -> dict:
     from kubeshare_tpu.serving import EngineConfig, Request, ServingEngine
 
     engine = ServingEngine(params, config, EngineConfig(
         num_slots=s["num_slots"], block_size=s["block_size"],
         num_blocks=s["num_blocks"], max_request_len=s["max_request_len"],
-        prefill_chunk=s["prefill_chunk"], prefix_cache=prefix_cache))
+        prefill_chunk=s["prefill_chunk"], prefix_cache=prefix_cache),
+        tenants=registry)
     engine.warmup()
     compiles_before = engine.compile_counts()
 
@@ -209,7 +300,9 @@ def run_continuous(params, config, s: dict, trace,
         now = time.monotonic() - start
         while pending and pending[0][3] <= now:
             rid, prompt, max_new, _ = pending.pop(0)
-            engine.submit(Request(rid, prompt, max_new))
+            engine.submit(Request(
+                rid, prompt, max_new,
+                tenant=(tenant_of[rid] if tenant_of else "default")))
         if not engine.step() and pending:
             time.sleep(min(0.001, pending[0][3] - now))
     elapsed = time.monotonic() - start
@@ -219,16 +312,30 @@ def run_continuous(params, config, s: dict, trace,
     useful = sum(min(len(engine.result(rid).tokens), max_new)
                  for rid, _, max_new, _ in trace)
     ttfts, per_token = [], []
+    requests = {}
     for rid, _, max_new, arrival in trace:
         r = engine.result(rid)
         ttfts.append((r.first_token_at - start) - arrival)
         if len(r.tokens) > 1:
             per_token.append(
                 (r.finished_at - r.first_token_at) / (len(r.tokens) - 1))
+        # raw per-request record for the multi-tenant suite (per-tenant
+        # aggregation + the bit-exact resume check); callers pop it
+        # before dumping JSON
+        requests[rid] = {
+            "arrival_s": arrival,
+            "ttft_s": (r.first_token_at - start) - arrival,
+            "finished_s": (r.finished_at - start) - arrival,
+            "tokens": list(r.tokens),
+        }
     # prefix-cache stats read back through the metrics surface (the
     # same families Prometheus scrapes), not private engine state
     metric = {(sm.name, tuple(sorted(sm.labels.items()))): sm.value
               for f in engine.collect_metrics() for sm in f.samples}
+    preemptions = {
+        labels[0][1]: int(v)
+        for (name, labels), v in metric.items()
+        if name == "kubeshare_serving_preemptions_total"}
     return {
         "tokens_per_s": useful / elapsed,
         "useful_tokens": useful,
@@ -249,7 +356,9 @@ def run_continuous(params, config, s: dict, trace,
              (("kind", "cow_copy"),))]),
         "evicted_blocks": int(metric[
             ("kubeshare_serving_prefix_evicted_blocks_total", ())]),
+        "preemptions": preemptions,
         "recompiles": recompiles,
+        "requests": requests,
     }
 
 
@@ -353,6 +462,7 @@ def run_bench(s: dict) -> dict:
     # --shared-prefix owns the cache-on comparison
     continuous = run_continuous(params, config, s, trace,
                                 prefix_cache=False)
+    continuous.pop("requests")  # per-request raw data: multi-tenant only
     rtc = run_rtc(params, config, s, trace)
     recompiles = continuous.pop("recompiles") + rtc.pop("recompiles")
     if recompiles:
@@ -393,6 +503,8 @@ def run_shared_bench(s: dict) -> dict:
 
     cached = run_continuous(params, config, s, trace, prefix_cache=True)
     uncached = run_continuous(params, config, s, trace, prefix_cache=False)
+    cached.pop("requests")
+    uncached.pop("requests")
     recompiles = cached.pop("recompiles") + uncached.pop("recompiles")
     if recompiles:
         raise RuntimeError(
@@ -422,6 +534,122 @@ def run_shared_bench(s: dict) -> dict:
     }
 
 
+def _tenant_stats(requests: dict, trace, tenant_of, tenant: str) -> dict:
+    """Per-tenant aggregates over one run's raw request records:
+    tokens/s over the tenant's active span (first arrival to last
+    finish) plus TTFT percentiles."""
+    mine = [(rid, max_new, arrival)
+            for rid, _, max_new, arrival in trace
+            if tenant_of[rid] == tenant]
+    useful = sum(max_new for _, max_new, _ in mine)
+    first_arrival = min(arrival for _, _, arrival in mine)
+    last_finish = max(arrival + requests[rid]["finished_s"]
+                      for rid, _, arrival in mine)
+    ttfts = [requests[rid]["ttft_s"] for rid, _, _ in mine]
+    return {
+        "useful_tokens": useful,
+        "span_s": last_finish - first_arrival,
+        "tokens_per_s": useful / max(1e-9, last_finish - first_arrival),
+        "ttft_s": _percentiles(ttfts),
+    }
+
+
+def run_qos_bench(s: dict) -> dict:
+    """Multi-tenant QoS comparison at ONE shared KV-HBM budget:
+
+    - **isolated**: the Guarantee tenant's trace alone — its entitled
+      service level;
+    - **qos_on**: Guarantee + Opportunistic flood with the QoS subsystem
+      (class-priority fair queue, flood quota'd to half the pool,
+      cache-backed preemption);
+    - **qos_off**: the same merged trace through the single-tenant FIFO
+      engine — what PR 1-2 serving does under the same flood.
+
+    The acceptance criteria: under the flood the Guarantee tenant keeps
+    >= 80% of its isolated tokens/s and its TTFT p50 degrades < 2x,
+    while AGGREGATE throughput stays within 10% of the QoS-off run;
+    every request's stream is bit-exact across qos_on/qos_off (preempted
+    requests resume via the prefix cache); zero recompiles after warmup.
+    """
+    from kubeshare_tpu.models.transformer import (
+        TransformerConfig, transformer_init)
+    from kubeshare_tpu.serving import (QOS_OPPORTUNISTIC, TenantRegistry,
+                                       TenantSpec)
+
+    config = TransformerConfig(
+        vocab_size=s["vocab_size"], d_model=s["d_model"],
+        n_heads=s["n_heads"], n_kv_heads=s["n_kv_heads"],
+        n_layers=s["n_layers"], d_ff=s["d_ff"],
+        max_seq_len=s["max_seq_len"], dtype=jnp.float32,
+        positional="rope", attention="reference")
+    params = transformer_init(jax.random.PRNGKey(s["seed"]), config)
+    trace, tenant_of = build_qos_workload(s)
+    g_trace = [e for e in trace if tenant_of[e[0]] == "prod"]
+
+    def registry():
+        return TenantRegistry([
+            TenantSpec("prod"),
+            TenantSpec("batch", qos_class=QOS_OPPORTUNISTIC,
+                       kv_block_quota=s["o_quota_blocks"]),
+        ])
+
+    isolated = run_continuous(params, config, s, g_trace,
+                              registry=registry(), tenant_of=tenant_of)
+    qos_on = run_continuous(params, config, s, trace,
+                            registry=registry(), tenant_of=tenant_of)
+    qos_off = run_continuous(params, config, s, trace)
+    recompiles = (isolated.pop("recompiles") + qos_on.pop("recompiles")
+                  + qos_off.pop("recompiles"))
+    if recompiles:
+        raise RuntimeError(
+            f"{recompiles} recompilations after warmup — a static-shape "
+            f"leak; the comparison (and a TPU serving pod) is invalid")
+    # preemption correctness, end to end: the greedy streams must be
+    # IDENTICAL with and without QoS scheduling — a preempted request's
+    # cache-backed resume may not change a single token
+    mismatched = [
+        rid for rid in qos_on["requests"]
+        if qos_on["requests"][rid]["tokens"]
+        != qos_off["requests"][rid]["tokens"]]
+    if mismatched:
+        raise RuntimeError(
+            f"streams diverged between qos_on and qos_off for "
+            f"{mismatched} — preemption resume is NOT bit-exact")
+    iso_req = isolated.pop("requests")
+    on_req = qos_on.pop("requests")
+    off_req = qos_off.pop("requests")
+    iso_g = _tenant_stats(iso_req, g_trace, tenant_of, "prod")
+    on_g = _tenant_stats(on_req, trace, tenant_of, "prod")
+    on_o = _tenant_stats(on_req, trace, tenant_of, "batch")
+    off_g = _tenant_stats(off_req, trace, tenant_of, "prod")
+    return {
+        "suite": "serving-qos",
+        "metric": "Guarantee tenant retention under an Opportunistic "
+                  "flood (same merged trace, same KV-HBM budget): "
+                  "qos_on guarantee tokens/s over isolated, TTFT p50 "
+                  "ratio, and aggregate qos_on/qos_off tokens/s",
+        "settings": {k: v for k, v in s.items()},
+        "isolated_guarantee": iso_g,
+        "qos_on": qos_on,
+        "qos_on_guarantee": on_g,
+        "qos_on_opportunistic": on_o,
+        "qos_off": qos_off,
+        "qos_off_guarantee": off_g,
+        "guarantee_retention": on_g["tokens_per_s"]
+        / max(1e-9, iso_g["tokens_per_s"]),
+        "guarantee_ttft_p50_ratio": on_g["ttft_s"]["p50"]
+        / max(1e-9, iso_g["ttft_s"]["p50"]),
+        "qos_off_guarantee_ttft_p50_ratio": off_g["ttft_s"]["p50"]
+        / max(1e-9, iso_g["ttft_s"]["p50"]),
+        "aggregate_ratio": qos_on["tokens_per_s"]
+        / max(1e-9, qos_off["tokens_per_s"]),
+        "preemptions": qos_on["preemptions"],
+        "streams_bit_exact": True,
+        "recompiles_after_warmup": recompiles,
+        "platform": jax.default_backend(),
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--smoke", action="store_true",
@@ -429,9 +657,15 @@ def main() -> None:
     parser.add_argument("--shared-prefix", action="store_true",
                         help="prefix-cache on/off comparison on a "
                              "shared-prefix trace")
+    parser.add_argument("--multi-tenant", action="store_true",
+                        help="QoS comparison: Guarantee tenant + "
+                             "Opportunistic flood at one KV-HBM budget")
     parser.add_argument("--json", help="write the result JSON here too")
     args = parser.parse_args()
-    if args.shared_prefix:
+    if args.multi_tenant:
+        result = run_qos_bench(
+            qos_smoke_settings() if args.smoke else qos_settings())
+    elif args.shared_prefix:
         result = run_shared_bench(
             shared_smoke_settings() if args.smoke else shared_settings())
     else:
@@ -442,6 +676,18 @@ def main() -> None:
     if args.json:
         with open(args.json, "w") as f:
             f.write(text + "\n")
+    if args.multi_tenant:
+        print(f"\nguarantee retention under flood: "
+              f"{result['guarantee_retention']:.3f} (target >= 0.8); "
+              f"guarantee TTFT p50 ratio: "
+              f"{result['guarantee_ttft_p50_ratio']:.2f}x (target < 2x, "
+              f"qos-off was "
+              f"{result['qos_off_guarantee_ttft_p50_ratio']:.2f}x); "
+              f"aggregate qos-on/qos-off: "
+              f"{result['aggregate_ratio']:.3f} (target >= 0.9); "
+              f"preemptions: {result['preemptions']}; streams bit-exact",
+              file=sys.stderr)
+        return
     ratio = result["ratio"]
     if args.shared_prefix:
         print(f"\nprefix-cache on/off tokens/s ratio: {ratio:.3f} "

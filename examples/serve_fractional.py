@@ -53,7 +53,9 @@ def main() -> None:
     from kubeshare_tpu.models.transformer import (
         TransformerConfig, transformer_init)
     from kubeshare_tpu.runtime import find_binary
-    from kubeshare_tpu.serving import EngineConfig, Request, ServingEngine
+    from kubeshare_tpu.serving import (QOS_OPPORTUNISTIC, EngineConfig,
+                                       Request, ServingEngine,
+                                       TenantRegistry, TenantSpec)
     from kubeshare_tpu.utils.atomicfile import write_atomic
 
     tokend = find_binary("tpushare-tokend")
@@ -111,7 +113,18 @@ def main() -> None:
     try:
         client = TokenClient("127.0.0.1", port, "demo/serve-pod")
         guard = ExecutionGuard(client=client, from_env=False)
-        engine = ServingEngine(params, config, engine_config, guard=guard)
+        # two tenants INSIDE the pod: the paper's Guarantee/Opportunistic
+        # split applied to the serving plane — "prod" is guaranteed,
+        # "batch" is opportunistic with a KV-HBM quota of 3/4 of the
+        # pool (loose enough to soak every slot, so prod must preempt)
+        # and is the preemption victim when prod can't admit
+        tenants = TenantRegistry([
+            TenantSpec("prod"),
+            TenantSpec("batch", qos_class=QOS_OPPORTUNISTIC,
+                       kv_block_quota=3 * (engine_config.num_blocks - 1) // 4),
+        ])
+        engine = ServingEngine(params, config, engine_config, guard=guard,
+                               tenants=tenants)
 
         print("=== 3. compile once, serve any mix (zero recompiles) ===")
         # warm the jit caches OUTSIDE the gated window, like the
@@ -120,13 +133,28 @@ def main() -> None:
         warm_counts = engine.compile_counts()
         print(f"compiled steps: {warm_counts}")
 
-        print("=== 4. requests: 8 mixed-length prompts through 4 slots ===")
-        # half the prompts open with one shared 24-token prefix (the
-        # system-prompt traffic shape) so the radix prefix cache has
-        # something to hit once early sharers retire
+        print("=== 4. requests: an opportunistic flood, then prod "
+              "traffic preempting through it ===")
+        # half the prod prompts open with one shared 24-token prefix
+        # (the system-prompt traffic shape) so the radix prefix cache
+        # has something to hit once early sharers retire.  The batch
+        # flood is submitted FIRST and holds every slot with long
+        # decodes — prod admissions preempt it (the victims' blocks go
+        # into the prefix cache, so their resumes are nearly free).
         rng = np.random.default_rng(0)
         shared_prefix = rng.integers(0, config.vocab_size, 24)
         requests = []
+        for i in range(6):  # the flood: long decodes, all slots
+            prompt = rng.integers(0, config.vocab_size,
+                                  int(rng.integers(12, 49)))
+            requests.append(Request(f"batch{i}", prompt,
+                                    int(rng.integers(48, 97)),
+                                    tenant="batch"))
+            engine.submit(requests[-1])
+        # let the flood actually OCCUPY the slots (live-traffic shape:
+        # prod arrives while batch decodes) — prod must then preempt
+        for _ in range(24):
+            engine.step()
         for i in range(8):
             prompt_len = int(rng.integers(12, 97))
             max_new = int(rng.integers(8, 49))
@@ -134,7 +162,8 @@ def main() -> None:
             if i % 2:
                 prompt = np.concatenate([shared_prefix, prompt[24:]]) \
                     if prompt_len > 24 else prompt
-            requests.append(Request(f"req{i}", prompt, max_new))
+            requests.append(Request(f"prod{i}", prompt, max_new,
+                                    tenant="prod"))
             engine.submit(requests[-1])
         start = time.monotonic()
         results = engine.run()
@@ -143,10 +172,15 @@ def main() -> None:
         for req in requests:
             r = results[req.rid]
             total += len(r.tokens)
-            print(f"{req.rid}: prompt {r.prompt_len:3d} -> "
-                  f"{len(r.tokens):2d} tokens, "
+            print(f"{req.rid:7s} [{req.tenant:5s}]: prompt "
+                  f"{r.prompt_len:3d} -> {len(r.tokens):2d} tokens, "
                   f"ttft {1e3 * r.ttft:6.1f} ms, "
                   f"done +{1e3 * (r.finished_at - r.submitted_at):6.1f} ms")
+        print(f"qos: preemptions by tenant {engine.preemptions}; "
+              f"tokens by tenant {engine.tenant_tokens}; "
+              f"batch quota occupancy "
+              f"{engine.allocator.tenant_usage('batch')}/"
+              f"{tenants.get('batch').kv_block_quota} blocks")
         end_counts = engine.compile_counts()
         recompiles = sum(end_counts.values()) - sum(warm_counts.values())
         print(f"aggregate: {total} tokens in {elapsed:.2f} s "

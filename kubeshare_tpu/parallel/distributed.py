@@ -130,6 +130,7 @@ def multislice_spec_from_env(
 def slice_device_mesh(
     ms: MultisliceSpec,
     axis_names: tuple = ("dcn", "device"),
+    devices=None,
 ) -> "jax.sharding.Mesh":
     """Global mesh whose OUTER axis is the slice boundary.
 
@@ -137,18 +138,27 @@ def slice_device_mesh(
     grouping is read straight off the hardware.  Elsewhere (the CPU
     dryrun analogue) each process knows only its own slice id, so the
     processes allgather their ids once and group devices by owning
-    process.  Either way the returned mesh is (num_slices, -1): shard
+    process — except when the calling process is the ONLY process and
+    holds every device itself (the single-process virtual-topology
+    dryrun): there is nobody to gather from, so the devices partition
+    contiguously by id into ``num_slices`` groups, simulating the DCN
+    boundary.  Either way the returned mesh is (num_slices, -1): shard
     data-parallel axes on ``dcn`` (allreduce-tolerant of DCN latency),
     keep tensor/sequence axes inner where collectives ride ICI.
+
+    ``devices`` restricts the mesh to an explicit device list (default:
+    all of ``jax.devices()``).
     """
     import jax
     import numpy as np
 
-    devices = jax.devices()
+    if devices is None:
+        devices = jax.devices()
     if len(devices) % ms.num_slices != 0:
         raise ValueError(
             f"{len(devices)} devices do not tile {ms.num_slices} slices"
         )
+    per_slice = len(devices) // ms.num_slices
     hw_slices = {getattr(d, "slice_index", None) for d in devices}
     if None not in hw_slices and len(hw_slices) == ms.num_slices:
         # real multislice: the runtime stamps every device's slice and
@@ -157,6 +167,13 @@ def slice_device_mesh(
         # slice_index 0 — means the attribute does NOT carry the DCN
         # layout; group by process instead.)
         slice_of = {d: d.slice_index for d in devices}
+    elif jax.process_count() == 1:
+        # single-process virtual topology: all devices are local and
+        # unstamped — a 2-slice x 4-device dryrun on an 8-device CPU
+        # mesh lands here.  Contiguous id-order grouping keeps "slice"
+        # neighborhoods intact the way the hardware path would.
+        ordered = sorted(devices, key=lambda d: d.id)
+        slice_of = {d: i // per_slice for i, d in enumerate(ordered)}
     else:
         from jax.experimental import multihost_utils
 
@@ -165,7 +182,6 @@ def slice_device_mesh(
         ).reshape(-1)
         proc_slice = {p: int(s) for p, s in enumerate(gathered)}
         slice_of = {d: proc_slice[d.process_index] for d in devices}
-    per_slice = len(devices) // ms.num_slices
     counts = {}
     for d in devices:
         counts[slice_of[d]] = counts.get(slice_of[d], 0) + 1

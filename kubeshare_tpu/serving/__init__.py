@@ -21,25 +21,43 @@ The serving subsystem the fractional-chip runtime was built to host:
   matched blocks straight into a new slot's page table (refcounted
   sharing, copy-on-write on mid-block divergence) and prefill starts at
   the first uncached token; unreferenced cached blocks park in an LRU
-  pool drained only when a reservation would otherwise fail.
+  pool drained only when a reservation would otherwise fail;
+- :mod:`qos` — multi-tenant QoS inside the serving plane: a tenant
+  registry (Guarantee/Opportunistic classes mirroring the scheduler's
+  priority semantics, fair-share weights, per-tenant KV-HBM block
+  quotas) and a token-weighted fair queue with tokend's decayed-share
+  virtual-time accounting; admission pulls from it instead of FIFO, and
+  a Guarantee admission the pool cannot fund preempts an Opportunistic
+  decode slot — cache-backed, so the victim resumes bit-exactly from
+  its first uncached token.
 """
 
 from .engine import (EngineConfig, Request, RequestResult, ServingEngine,
                      plan_prefill_chunks)
-from .kv_blocks import BlockExhausted, BlockAllocator, PagedKVPool, init_paged_pool
+from .kv_blocks import (BlockAllocator, BlockExhausted, PagedKVPool,
+                        QuotaExceeded, init_paged_pool)
 from .paged import (paged_copy_block, paged_decode_step, paged_gather_kv,
                     paged_prefill_step)
 from .prefix_index import PrefixIndex
+from .qos import (DEFAULT_TENANT, QOS_GUARANTEE, QOS_OPPORTUNISTIC,
+                  FairQueue, TenantRegistry, TenantSpec)
 
 __all__ = [
     "BlockAllocator",
     "BlockExhausted",
+    "DEFAULT_TENANT",
     "EngineConfig",
+    "FairQueue",
     "PagedKVPool",
     "PrefixIndex",
+    "QOS_GUARANTEE",
+    "QOS_OPPORTUNISTIC",
+    "QuotaExceeded",
     "Request",
     "RequestResult",
     "ServingEngine",
+    "TenantRegistry",
+    "TenantSpec",
     "init_paged_pool",
     "paged_copy_block",
     "paged_decode_step",

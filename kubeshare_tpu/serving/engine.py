@@ -46,14 +46,27 @@ its fused first-token pick, decode span) charges through an
 :class:`~kubeshare_tpu.isolation.ExecutionGuard` when one is given, so a
 0.5-chip serving pod's engine is gated exactly like the run-to-
 completion path it replaces (examples/serve_fractional.py).
+
+MULTI-TENANT QoS (qos.py): requests name a TENANT; admission pulls from
+a token-weighted fair queue (Guarantee class strictly ahead of
+Opportunistic, decayed service/weight within a class — tokend's share
+model applied to tokens) instead of global FIFO; per-tenant KV-HBM
+block quotas are charged in the allocator; and a Guarantee admission
+the pool cannot fund PREEMPTS an Opportunistic decode slot — the
+victim's prompt + generated blocks retire into the prefix index, its
+request re-queues at the front of its tenant's lane, and on
+re-admission the trie match starts prefill at its first uncached token,
+so the resumed stream is bit-exact with the unpreempted one (greedy and
+sampled: the victim's remaining PRNG key schedule rides with the
+re-queued request).  The radix cache is what makes preemption nearly
+free: the only recomputed work is the sliding bucketed tail chunk.
 """
 
 from __future__ import annotations
 
 import time
-from collections import deque
 from dataclasses import dataclass, field, replace
-from typing import Deque, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -63,14 +76,43 @@ from ..models.decoding import _filter_logits, bucket_width
 from ..models.transformer import TransformerConfig
 from ..utils.promtext import (MetricFamily, MetricServer, Sample,
                               _format_value)
-from .kv_blocks import BlockAllocator, BlockExhausted, init_paged_pool
+from .kv_blocks import (BlockAllocator, BlockExhausted, QuotaExceeded,
+                        init_paged_pool)
 from .paged import paged_copy_block, paged_decode_step, paged_prefill_step
 from .prefix_index import PrefixIndex
+from .qos import (DEFAULT_TENANT, QOS_GUARANTEE, QOS_OPPORTUNISTIC,
+                  FairQueue, TenantRegistry, TenantSpec)
 
 # TTFT histogram bucket upper bounds (seconds) for the metrics endpoint
 # — spans sub-chunk CPU smoke latencies up to badly queued tail requests.
 TTFT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
                 10.0)
+
+
+def _bucket_observe(counts: List[int], seconds: float) -> None:
+    """Increment the TTFT_BUCKETS histogram slot covering ``seconds``
+    (last slot is the +Inf tail)."""
+    for i, le in enumerate(TTFT_BUCKETS):
+        if seconds <= le:
+            counts[i] += 1
+            return
+    counts[-1] += 1
+
+
+def _histogram_samples(family: MetricFamily, name: str, labels: Dict[str, str],
+                       counts: List[int], total: float) -> None:
+    """Append one Prometheus histogram series (cumulative buckets +
+    sum + count) over TTFT_BUCKETS to ``family``."""
+    cum = 0
+    for le, count in zip(TTFT_BUCKETS, counts):
+        cum += count
+        family.samples.append(Sample(
+            f"{name}_bucket", {**labels, "le": _format_value(le)}, cum))
+    cum += counts[-1]
+    family.samples.append(Sample(
+        f"{name}_bucket", {**labels, "le": "+Inf"}, cum))
+    family.samples.append(Sample(f"{name}_sum", labels, total))
+    family.samples.append(Sample(f"{name}_count", labels, cum))
 
 
 def plan_prefill_chunks(
@@ -147,13 +189,39 @@ class Request:
     """One generation request.  ``temperature == 0`` is greedy;
     sampled requests must carry their own PRNG ``rng`` (the engine
     consumes keys exactly like ``sample_decode_with_cache``, so a
-    single-slot engine reproduces it bit-for-bit)."""
+    single-slot engine reproduces it bit-for-bit).  ``tenant`` names a
+    registered :class:`~kubeshare_tpu.serving.qos.TenantSpec`; the
+    default registry has one uncapped Guarantee tenant, so single-tenant
+    callers never touch QoS."""
 
     rid: str
     prompt: np.ndarray
     max_new_tokens: int
     temperature: float = 0.0
     rng: Optional[jax.Array] = None
+    tenant: str = DEFAULT_TENANT
+
+
+@dataclass
+class _Pending:
+    """A queued (or preempted-and-requeued) request with everything
+    admission needs precomputed.  Fresh submissions carry ``rng`` and
+    derive their key schedule at first admission; a RESUMED entry
+    carries the remaining schedule explicitly (``first_key`` +
+    ``step_keys``) plus the tokens already emitted, so the continuation
+    consumes exactly the keys the unpreempted run would have."""
+
+    rid: str
+    tenant: str
+    prompt: np.ndarray
+    max_new: int
+    temperature: float
+    plan: List[Tuple[int, int, int]]
+    needed: int
+    rng: Optional[jax.Array] = None
+    first_key: Optional[np.ndarray] = None
+    step_keys: Optional[np.ndarray] = None
+    emitted: List[int] = field(default_factory=list)
 
 
 @dataclass
@@ -181,7 +249,7 @@ class _Slot:
     __slots__ = (
         "idx", "state", "rid", "blocks", "table", "length", "generated",
         "prompt", "plan", "max_new", "temperature", "first_key",
-        "step_keys", "result",
+        "step_keys", "result", "tenant", "emitted_prefix",
     )
 
     def __init__(self, idx: int, table_width: int) -> None:
@@ -203,6 +271,10 @@ class _Slot:
         self.first_key = None
         self.step_keys = None
         self.result: Optional[RequestResult] = None
+        self.tenant = DEFAULT_TENANT
+        # tokens emitted in earlier incarnations of a preempted request;
+        # prepended to slot.generated at retirement
+        self.emitted_prefix: List[int] = []
 
 
 class ServingEngine:
@@ -218,6 +290,7 @@ class ServingEngine:
         config: TransformerConfig,
         engine_config: Optional[EngineConfig] = None,
         guard=None,
+        tenants: Optional[TenantRegistry] = None,
     ) -> None:
         ec = engine_config or EngineConfig()
         if ec.max_request_len > config.max_seq_len:
@@ -245,9 +318,12 @@ class ServingEngine:
         self._table_width = -(-ec.max_request_len // ec.block_size)
         self._slots = [_Slot(i, self._table_width)
                        for i in range(ec.num_slots)]
-        # (request, prefill plan, blocks needed) — computed once at
-        # submit; _admit re-plans only on a prefix-cache hit
-        self._queue: Deque[Tuple[Request, List[Tuple[int, int, int]], int]] = deque()
+        # admission queue: the QoS fair queue over _Pending entries
+        # (plan + block count computed once at submit; _admit re-plans
+        # only on a prefix-cache hit).  The default registry holds one
+        # uncapped Guarantee tenant, making this exactly a FIFO.
+        self.tenants = tenants or TenantRegistry.default()
+        self._queue = FairQueue(self.tenants)
         self._results: Dict[str, RequestResult] = {}
         # counters (the bench's and the metrics endpoint's raw material)
         self.decode_steps = 0
@@ -261,6 +337,13 @@ class ServingEngine:
         self.cow_copies = 0
         self._ttft_counts = [0] * (len(TTFT_BUCKETS) + 1)  # +Inf tail
         self._ttft_sum = 0.0
+        # QoS counters: preemptions by victim tenant, emitted tokens by
+        # tenant, and a TTFT histogram per QoS class
+        self.preemptions: Dict[str, int] = {}
+        self.tenant_tokens: Dict[str, int] = {}
+        self._ttft_class: Dict[str, list] = {
+            cls: [[0] * (len(TTFT_BUCKETS) + 1), 0.0]
+            for cls in (QOS_GUARANTEE, QOS_OPPORTUNISTIC)}
 
         cfg = config
         top_k, top_p = ec.top_k, ec.top_p
@@ -351,6 +434,10 @@ class ServingEngine:
             raise ValueError("sampled requests (temperature > 0) must carry rng")
         if request.rid in self._results and not self._results[request.rid].done:
             raise ValueError(f"request id {request.rid!r} already in flight")
+        try:
+            spec = self.tenants.get(request.tenant)
+        except KeyError as exc:
+            raise ValueError(str(exc)) from None
         ec = self.engine_config
         plan, cover = plan_prefill_chunks(
             prompt.size, ec.prefill_chunk, ec.max_request_len)
@@ -369,12 +456,23 @@ class ServingEngine:
                 f"pool only has {self.allocator.num_blocks - 1} — it can "
                 f"NEVER be admitted (grow num_blocks or shrink the request)"
             )
+        if spec.kv_block_quota is not None and needed > spec.kv_block_quota:
+            raise QuotaExceeded(
+                f"request {request.rid!r} needs {needed} blocks but "
+                f"tenant {spec.name!r}'s quota is {spec.kv_block_quota} "
+                f"— it can NEVER be admitted (raise the quota or shrink "
+                f"the request)"
+            )
         result = RequestResult(rid=request.rid, prompt_len=prompt.size,
                                submitted_at=time.monotonic())
         self._results[request.rid] = result
         # the plan and block count ride with the queued request — _admit
         # must not redo this work on every scheduling tick
-        self._queue.append((replace(request, prompt=prompt), plan, needed))
+        self._queue.push(request.tenant, _Pending(
+            rid=request.rid, tenant=request.tenant, prompt=prompt,
+            max_new=request.max_new_tokens,
+            temperature=request.temperature, plan=plan, needed=needed,
+            rng=request.rng))
         return result
 
     def step(self) -> bool:
@@ -527,21 +625,42 @@ class ServingEngine:
             "kubeshare_serving_ttft_seconds",
             "Time to first token (submit to first emitted token).",
             "histogram")
-        cum = 0
-        for le, count in zip(TTFT_BUCKETS, self._ttft_counts):
-            cum += count
-            ttft.samples.append(Sample(
-                "kubeshare_serving_ttft_seconds_bucket",
-                {"le": _format_value(le)}, cum))
-        cum += self._ttft_counts[-1]
-        ttft.samples.append(Sample(
-            "kubeshare_serving_ttft_seconds_bucket", {"le": "+Inf"}, cum))
-        ttft.samples.append(Sample(
-            "kubeshare_serving_ttft_seconds_sum", {}, self._ttft_sum))
-        ttft.samples.append(Sample(
-            "kubeshare_serving_ttft_seconds_count", {}, cum))
+        _histogram_samples(ttft, "kubeshare_serving_ttft_seconds", {},
+                           self._ttft_counts, self._ttft_sum)
+        # ---- per-tenant QoS families ------------------------------------
+        t_depth = MetricFamily(
+            "kubeshare_serving_tenant_queue_depth",
+            "Queued (unadmitted) requests per tenant.", "gauge")
+        for name, depth in self._queue.depths().items():
+            t_depth.add({"tenant": name}, depth)
+        t_blocks = MetricFamily(
+            "kubeshare_serving_tenant_kv_blocks",
+            "KV pool blocks charged per tenant (in-use + idle-cached) — "
+            "quota occupancy.", "gauge")
+        usage = self.allocator.usage_by_tenant
+        for name in self.tenants.names():
+            t_blocks.add({"tenant": name}, usage.get(name, 0))
+        t_tokens = MetricFamily(
+            "kubeshare_serving_tenant_tokens_total",
+            "Tokens emitted per tenant.", "counter")
+        for name in self.tenants.names():
+            t_tokens.add({"tenant": name}, self.tenant_tokens.get(name, 0))
+        preempt = MetricFamily(
+            "kubeshare_serving_preemptions_total",
+            "Decode slots preempted, by victim tenant (the victim "
+            "resumes via the prefix cache).", "counter")
+        for name in self.tenants.names():
+            preempt.add({"tenant": name}, self.preemptions.get(name, 0))
+        cls_ttft = MetricFamily(
+            "kubeshare_serving_ttft_by_class_seconds",
+            "Time to first token by QoS class.", "histogram")
+        for cls, (counts, total) in sorted(self._ttft_class.items()):
+            _histogram_samples(
+                cls_ttft, "kubeshare_serving_ttft_by_class_seconds",
+                {"qos": cls}, counts, total)
         return [req, blocks, tokens, dispatches, prefix, hit_tokens,
-                evicted, ttft]
+                evicted, ttft, t_depth, t_blocks, t_tokens, preempt,
+                cls_ttft]
 
     def serve_metrics(self, port: int = 0) -> MetricServer:
         """Start the textfile HTTP scrape endpoint (``/metrics`` and
@@ -555,14 +674,13 @@ class ServingEngine:
     # ------------------------------------------------------------------
     # internals
     # ------------------------------------------------------------------
-    def _observe_ttft(self, seconds: float) -> None:
+    def _observe_ttft(self, seconds: float, tenant: str) -> None:
         self._ttft_sum += seconds
-        for i, le in enumerate(TTFT_BUCKETS):
-            if seconds <= le:
-                self._ttft_counts[i] += 1
-                return
-        self._ttft_counts[-1] += 1
-    def _match_prefix(self, req: Request) -> Tuple[int, List[int], Optional[int], List[Tuple[int, int, int]], int]:
+        cls = self._ttft_class[self.tenants.get(tenant).qos_class]
+        cls[1] += seconds
+        _bucket_observe(self._ttft_counts, seconds)
+        _bucket_observe(cls[0], seconds)
+    def _match_prefix(self, pending: _Pending) -> Tuple[int, List[int], Optional[int], List[Tuple[int, int, int]], int]:
         """Admission-time prefix lookup for one queued request: returns
         (start, shared_blocks, cow_src, plan, fresh_needed).  ``start``
         is the first token that must prefill (0 = cold); ``shared``
@@ -573,7 +691,7 @@ class ServingEngine:
         one real token in the prefill plan — its logits row IS the
         first output token."""
         ec = self.engine_config
-        prompt = req.prompt
+        prompt = pending.prompt
         matched, mblocks = self.prefix_index.match(prompt)
         matched = min(matched, prompt.size - 1)
         if matched <= 0:
@@ -583,14 +701,20 @@ class ServingEngine:
         cow_src = mblocks[n_keep] if matched % ec.block_size else None
         plan, cover = plan_prefill_chunks(
             prompt.size, ec.prefill_chunk, ec.max_request_len, matched)
-        total_rows = max(cover, prompt.size + req.max_new_tokens)
+        total_rows = max(cover, prompt.size + pending.max_new)
         fresh = self.allocator.blocks_for_tokens(total_rows) - n_keep
         return matched, mblocks[:n_keep], cow_src, plan, fresh
 
     def _admit(self) -> None:
-        """FIFO admission: pop queued requests into free slots while the
-        allocator can fund them.  Head-of-line blocking is deliberate —
-        skipping ahead would starve large requests forever.
+        """QoS admission: walk tenants in fair-queue order (Guarantee
+        class first, lowest decayed service/weight within a class) and
+        pop each tenant's head into a free slot while the allocator can
+        fund it.  WITHIN a tenant head-of-line blocking is deliberate —
+        skipping ahead would starve its large requests forever — but a
+        tenant blocked on its OWN quota is skipped so the rest of the
+        pool keeps flowing.  A Guarantee head the POOL cannot fund
+        preempts Opportunistic decode slots (cache-backed: see
+        :meth:`_preempt`) until it fits or no victims remain.
 
         With the prefix cache, admission first walks the prompt down the
         radix index and RETAINS every matched block (refcount +1 — a
@@ -598,74 +722,243 @@ class ServingEngine:
         follows), then reserves only the blocks the uncached suffix
         needs.  A partially matched tail block is copied-on-write into
         the first fresh block before the slot may append to it."""
-        while self._queue:
-            free = [s for s in self._slots if s.state == "free"]
-            if not free:
+        while True:
+            order = self._queue.order()
+            if not order:
                 return
-            req, plan, needed = self._queue[0]
-            start, shared, cow_src, hit_plan, hit_needed = 0, [], None, [], 0
-            if self.prefix_index is not None:
-                start, shared, cow_src, hit_plan, hit_needed = \
-                    self._match_prefix(req)
+            progressed = False
+            for tenant in order:
+                spec = self.tenants.get(tenant)
+                free = [s for s in self._slots if s.state == "free"]
+                if not free:
+                    # no slot for ANY tenant; a Guarantee head may take
+                    # one from an Opportunistic decode, everyone else
+                    # waits for a retirement.  A head blocked on its OWN
+                    # quota must not preempt (a victim's slot cannot
+                    # cure a quota block — it would thrash one victim
+                    # per tick); skip it like the "quota" outcome below.
+                    if self._quota_blocked(self._queue.peek(tenant), spec):
+                        continue
+                    if spec.is_guarantee and self._preempt_victim():
+                        free = [s for s in self._slots
+                                if s.state == "free"]
+                        progressed = True
+                    else:
+                        return
+                outcome = self._try_admit(self._queue.peek(tenant), spec,
+                                          free[0])
+                if outcome == "admitted":
+                    self._queue.pop(tenant)
+                    progressed = True
+                    break
+                if outcome == "quota":
+                    continue  # this tenant's own limit; try the next
+                # pool exhausted: Guarantee preempts, everyone else
+                # stops here (admitting a lower-ranked tenant past a
+                # blocked head would invert the fair order)
+                if spec.is_guarantee and self._preempt_victim():
+                    progressed = True
+                    break
+                return
+            if not progressed:
+                return
+
+    def _quota_blocked(self, pending: _Pending, spec: TenantSpec) -> bool:
+        """Would admitting ``pending`` fail on its tenant's OWN quota
+        both ways _try_admit can attempt it (prefix hit AND cold)?
+        Side-effect-free (the prefix match only reads the trie): asks
+        the allocator's dry-run gate with the blocks each path would
+        request, excluding to-be-retained shared blocks from the
+        drainable set on the hit path."""
+        if spec.kv_block_quota is None:
+            return False
+        if self.allocator.quota_can_fit(
+                pending.needed, spec.name, spec.kv_block_quota):
+            return False  # the cold fallback fits
+        if self.prefix_index is not None:
+            start, shared, cow_src, _, hit_needed = \
+                self._match_prefix(pending)
+            if start and self.allocator.quota_can_fit(
+                    hit_needed, spec.name, spec.kv_block_quota,
+                    keep=shared + ([cow_src] if cow_src is not None
+                                   else [])):
+                return False
+        return True
+
+    def _try_admit(self, pending: _Pending, spec: TenantSpec,
+                   slot: _Slot) -> str:
+        """Try to admit one queued request into ``slot``; returns
+        "admitted", "quota" (the tenant's own cap — skippable), or
+        "pool" (global shortfall).  A failed attempt rolls back every
+        retained block."""
+        plan, needed = pending.plan, pending.needed
+        start, shared, cow_src = 0, [], None
+        if self.prefix_index is not None:
+            start, shared, cow_src, hit_plan, hit_needed = \
+                self._match_prefix(pending)
             if start:
                 plan, needed = hit_plan, hit_needed
+        evict_first = (set(self.tenants.opportunistic())
+                       if spec.is_guarantee else None)
+        while True:
             retained = shared + ([cow_src] if cow_src is not None else [])
             if retained:
                 self.allocator.retain(retained)
             try:
-                blocks = self.allocator.reserve(needed, req.rid)
+                blocks = self.allocator.reserve(
+                    needed, pending.rid, tenant=spec.name,
+                    quota=spec.kv_block_quota,
+                    evict_tenants_first=evict_first)
+                break
+            except QuotaExceeded:
+                if retained:
+                    self.allocator.reclaim(retained)
+                if start:
+                    # a prefix HIT can be quota-infeasible where a cold
+                    # admission is not: the retained chain (+ transient
+                    # CoW source) pins charged blocks the quota drain
+                    # may not touch, so a request sized exactly to its
+                    # quota would re-block on its own cache every tick.
+                    # Retry cold — the hit saves FLOPs, never
+                    # correctness, and the cold reserve may now evict
+                    # the matched chain itself.
+                    start, shared, cow_src = 0, [], None
+                    plan, needed = pending.plan, pending.needed
+                    continue
+                return "quota"
             except BlockExhausted:
                 if retained:
                     self.allocator.reclaim(retained)
-                return  # stays queued; retirement will free blocks
-            self._queue.popleft()
-            slot = free[0]
-            slot.state = "prefill"
-            slot.rid = req.rid
-            # table order: [shared prefix blocks | CoW copy (blocks[0],
-            # when the match ends mid-block) | fresh suffix blocks]
-            slot.blocks = shared + blocks
-            slot.table[:] = 0
-            slot.table[: len(slot.blocks)] = slot.blocks
-            slot.length = 0
-            if cow_src is not None:
-                pk, pv = self._dispatch(
-                    self._copy_step, self.pool.k, self.pool.v,
-                    jnp.asarray(cow_src, jnp.int32),
-                    jnp.asarray(blocks[0], jnp.int32))
-                self.pool = replace(self.pool, k=pk, v=pv)
-                self.allocator.reclaim([cow_src])  # transient read ref
-                self.cow_copies += 1
-            if start:
-                # honest skip count: the bucketed tail may slide BELOW
-                # the match point (or a tiny prompt replans from 0),
-                # re-prefilling cached rows — only rows no plan chunk
-                # rewrites were actually skipped
-                skipped = min(start, min(s for s, _, _ in plan))
-                self.prefix_hit_requests += 1
-                self.prefix_hit_tokens += skipped
-            self.requests_admitted += 1
-            slot.generated = []
-            slot.prompt = req.prompt
-            slot.plan = list(plan)
-            slot.max_new = req.max_new_tokens
-            slot.temperature = req.temperature
-            if req.temperature > 0.0:
-                # EXACTLY sample_decode_with_cache's key schedule: one
-                # split for the first token, then the step keys in bulk
-                rng, first_key = jax.random.split(req.rng)
-                slot.first_key = np.asarray(first_key)
-                slot.step_keys = (
-                    np.asarray(jax.random.split(rng, req.max_new_tokens - 1))
-                    if req.max_new_tokens > 1 else
-                    np.zeros((0, 2), np.uint32))
-            else:
-                slot.first_key = np.zeros((2,), np.uint32)
-                slot.step_keys = np.zeros((0, 2), np.uint32)
-            slot.result = self._results[req.rid]
+                return "pool"
+        slot.state = "prefill"
+        slot.rid = pending.rid
+        slot.tenant = spec.name
+        # table order: [shared prefix blocks | CoW copy (blocks[0],
+        # when the match ends mid-block) | fresh suffix blocks]
+        slot.blocks = shared + blocks
+        slot.table[:] = 0
+        slot.table[: len(slot.blocks)] = slot.blocks
+        slot.length = 0
+        if cow_src is not None:
+            pk, pv = self._dispatch(
+                self._copy_step, self.pool.k, self.pool.v,
+                jnp.asarray(cow_src, jnp.int32),
+                jnp.asarray(blocks[0], jnp.int32))
+            self.pool = replace(self.pool, k=pk, v=pv)
+            self.allocator.reclaim([cow_src])  # transient read ref
+            self.cow_copies += 1
+        if start:
+            # honest skip count: the bucketed tail may slide BELOW
+            # the match point (or a tiny prompt replans from 0),
+            # re-prefilling cached rows — only rows no plan chunk
+            # rewrites were actually skipped
+            skipped = min(start, min(s for s, _, _ in plan))
+            self.prefix_hit_requests += 1
+            self.prefix_hit_tokens += skipped
+        self.requests_admitted += 1
+        slot.generated = []
+        slot.emitted_prefix = list(pending.emitted)
+        slot.prompt = pending.prompt
+        slot.plan = list(plan)
+        slot.max_new = pending.max_new
+        slot.temperature = pending.temperature
+        if pending.first_key is not None:
+            # resumed after preemption: the remaining key schedule rides
+            # with the pending entry (re-splitting rng would re-issue
+            # keys the first incarnation already consumed)
+            slot.first_key = pending.first_key
+            slot.step_keys = pending.step_keys
+        elif pending.temperature > 0.0:
+            # EXACTLY sample_decode_with_cache's key schedule: one
+            # split for the first token, then the step keys in bulk
+            rng, first_key = jax.random.split(pending.rng)
+            slot.first_key = np.asarray(first_key)
+            slot.step_keys = (
+                np.asarray(jax.random.split(rng, pending.max_new - 1))
+                if pending.max_new > 1 else
+                np.zeros((0, 2), np.uint32))
+        else:
+            slot.first_key = np.zeros((2,), np.uint32)
+            slot.step_keys = np.zeros((0, 2), np.uint32)
+        slot.result = self._results[pending.rid]
+        if slot.result.admitted_at is None:
             slot.result.admitted_at = time.monotonic()
-            self.peak_blocks_in_use = max(
-                self.peak_blocks_in_use, self.allocator.blocks_in_use)
+        self.peak_blocks_in_use = max(
+            self.peak_blocks_in_use, self.allocator.blocks_in_use)
+        return "admitted"
+
+    def _preempt_victim(self) -> bool:
+        """Pick and preempt one Opportunistic DECODE slot for a starved
+        Guarantee admission; returns False when none exists.  Victim
+        choice: the slot holding the most blocks (each preemption frees
+        the most HBM, so a Guarantee admission needs the fewest victims);
+        highest slot index breaks ties deterministically.  Prefill-state
+        slots are never preempted — their prompt is mid-write and worth
+        nothing to the cache yet."""
+        victims = [
+            s for s in self._slots
+            if s.state == "decode"
+            and not self.tenants.get(s.tenant).is_guarantee]
+        if not victims:
+            return False
+        self._preempt(max(victims, key=lambda s: (len(s.blocks), s.idx)))
+        return True
+
+    def _preempt(self, slot: _Slot) -> None:
+        """Cache-backed preemption: retire the victim's prompt AND
+        generated blocks into the prefix index, free its slot, and
+        re-queue the remainder at the front of its tenant's lane.
+
+        The cache holds K/V for positions ``0 .. slot.length - 1`` =
+        ``prompt + generated[:-1]`` (the newest emitted token's K/V is
+        written by the NEXT decode step), so exactly that sequence is
+        indexed.  The resume request's prompt is ``prompt + generated``
+        — its last token is the first uncached one, so re-admission's
+        trie walk restarts prefill right there and the continuation is
+        bit-exact (sampled requests carry their remaining key schedule:
+        emission k of the original consumes ``step_keys[k-1]``, which
+        becomes the resumed request's ``first_key``)."""
+        done = len(slot.generated)  # >= 1 in decode state
+        if self.prefix_index is not None:
+            cached_seq = np.concatenate(
+                [slot.prompt,
+                 np.asarray(slot.generated[:-1], np.int32)])
+            n_cached = self.allocator.blocks_for_tokens(slot.length)
+            cached_blocks = [int(b) for b in slot.table[:n_cached]]
+            newly_cached, displaced = self.prefix_index.insert(
+                cached_seq, cached_blocks)
+            self.allocator.mark_cached(newly_cached)
+            for b in displaced:
+                self.allocator.uncache(b)
+        # reclaim TAIL-first: idle-LRU order then evicts the chain's
+        # deepest block (a leaf subtree) before its head — a following
+        # reservation that needs only a few blocks shaves the cached
+        # chain instead of wiping it, so the resume still hits
+        self.allocator.reclaim(slot.blocks[::-1])
+        ec = self.engine_config
+        resume_prompt = np.concatenate(
+            [slot.prompt, np.asarray(slot.generated, np.int32)])
+        remaining = slot.max_new - done
+        plan, cover = plan_prefill_chunks(
+            resume_prompt.size, ec.prefill_chunk, ec.max_request_len)
+        needed = self.allocator.blocks_for_tokens(
+            max(cover, resume_prompt.size + remaining))
+        if slot.temperature > 0.0:
+            first_key = np.asarray(slot.step_keys[done - 1])
+            step_keys = np.asarray(slot.step_keys[done:])
+        else:
+            first_key = np.zeros((2,), np.uint32)
+            step_keys = np.zeros((0, 2), np.uint32)
+        self._queue.requeue_front(slot.tenant, _Pending(
+            rid=slot.rid, tenant=slot.tenant, prompt=resume_prompt,
+            max_new=remaining, temperature=slot.temperature,
+            plan=plan, needed=needed, first_key=first_key,
+            step_keys=step_keys,
+            emitted=slot.emitted_prefix + slot.generated))
+        self.preemptions[slot.tenant] = \
+            self.preemptions.get(slot.tenant, 0) + 1
+        slot._clear()
+        slot.state = "free"
 
     def _dispatch(self, fn, *args):
         """Every device burst charges through the guard — the same
@@ -703,6 +996,10 @@ class ServingEngine:
                          np.zeros(2, np.uint32))[None]))
         self.pool = replace(self.pool, k=pk, v=pv)
         self.prefill_chunks += 1
+        # fair-share service: the prefill width actually dispatched (a
+        # prefix-cache hit charges only its uncached suffix — tokend's
+        # charge-measured-work principle)
+        self._queue.charge(slot.tenant, width)
         if not final:
             return
         # prompt fully cached: the fused pick at the final chunk's
@@ -710,9 +1007,15 @@ class ServingEngine:
         first = int(np.asarray(picked)[0])
         slot.length = slot.prompt.size
         slot.generated = [first]
-        slot.result.first_token_at = time.monotonic()
-        self._observe_ttft(slot.result.ttft)
+        if slot.result.first_token_at is None:
+            # a RESUMED slot keeps its original first-token time — TTFT
+            # is a property of the request, not of its incarnations
+            slot.result.first_token_at = time.monotonic()
+            self._observe_ttft(slot.result.ttft, slot.tenant)
         self.tokens_generated += 1
+        self.tenant_tokens[slot.tenant] = \
+            self.tenant_tokens.get(slot.tenant, 0) + 1
+        self._queue.charge(slot.tenant, 1)
         slot.state = "decode"
         self._maybe_retire(slot, first)
 
@@ -754,13 +1057,19 @@ class ServingEngine:
             # min(budget, span) tokens, truncated at EOS (inclusive) —
             # every accepted token's K/V write happened on an alive lane
             take = min(int(budgets[i]), span)
+            accepted = 0
             for t in range(take):
                 tok = int(emitted[t, i])
                 slot.length += 1
                 slot.generated.append(tok)
                 self.tokens_generated += 1
+                accepted += 1
                 if ec.eos_token is not None and tok == ec.eos_token:
                     break
+            if accepted:
+                self.tenant_tokens[slot.tenant] = \
+                    self.tenant_tokens.get(slot.tenant, 0) + accepted
+                self._queue.charge(slot.tenant, accepted)
             self._maybe_retire(slot, slot.generated[-1])
 
     def _maybe_retire(self, slot: _Slot, token: int) -> None:
@@ -768,7 +1077,9 @@ class ServingEngine:
         if len(slot.generated) >= slot.max_new or (
                 eos is not None and token == eos):
             result = slot.result
-            result.tokens = list(slot.generated)
+            # a preempted-and-resumed request's earlier incarnations'
+            # tokens come first — the caller sees ONE contiguous stream
+            result.tokens = slot.emitted_prefix + list(slot.generated)
             result.finished_at = time.monotonic()
             if self.prefix_index is not None:
                 # index the prompt's blocks BEFORE dropping our refs:
@@ -787,7 +1098,9 @@ class ServingEngine:
                 self.allocator.mark_cached(newly_cached)
                 for b in displaced:
                     self.allocator.uncache(b)
-            self.allocator.reclaim(slot.blocks)
+            # tail-first reclaim: see _preempt — eviction shaves chains
+            # from the deepest block, preserving the shared head
+            self.allocator.reclaim(slot.blocks[::-1])
             self.requests_finished += 1
             slot._clear()
             slot.state = "free"

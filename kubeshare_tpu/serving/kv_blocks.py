@@ -41,6 +41,15 @@ class BlockExhausted(RuntimeError):
     clamped or overwritten."""
 
 
+class QuotaExceeded(BlockExhausted):
+    """A reservation fits the POOL but not the requesting TENANT's
+    KV-HBM block quota (and the tenant's own idle-cached blocks, once
+    drained, still don't make room).  Distinct from
+    :class:`BlockExhausted` so the engine can skip just this tenant and
+    keep admitting others — a per-tenant limit must never become
+    head-of-line blocking for the whole pool."""
+
+
 @dataclass(frozen=True)
 class PagedKVPool:
     """The static device-side block pool.
@@ -111,6 +120,21 @@ class BlockAllocator:
     too, because every reader retains the full chain).  The allocator
     verifies each returned block really was idle-cached; a live block
     coming back from the evictor is a corruption, not a policy choice.
+
+    **Tenant charging** (the QoS subsystem's HBM ledger): a reservation
+    made with ``tenant=`` charges every granted block to that tenant
+    until the block returns to the free list — through its in-use life
+    AND any idle-cached afterlife (a cached block still occupies HBM
+    attributable to whoever brought it in).  ``retain`` does NOT move
+    the charge: a prefix block shared across tenants is charged once,
+    to the tenant that paid its prefill.  A ``quota=`` reservation that
+    would push the tenant's charge over its cap first drains the
+    tenant's OWN idle-cached blocks (its cache must never wedge its own
+    quota), then raises :class:`QuotaExceeded`.  A Guarantee tenant's
+    reservation passes ``evict_tenants_first=`` (the opportunistic
+    tenant set) so the LRU drain reclaims idle-cached blocks charged to
+    Opportunistic tenants before touching anyone else's — the paper's
+    class asymmetry applied to cache HBM.
     """
 
     def __init__(self, num_blocks: int, block_size: int,
@@ -131,6 +155,10 @@ class BlockAllocator:
         # refcount-0 cached blocks, least recently released first
         self._idle: "OrderedDict[int, None]" = OrderedDict()
         self.evicted_blocks = 0  # lifetime eviction counter (metrics)
+        # QoS charge ledger: block id -> charged tenant, tenant -> blocks
+        # charged (in-use + idle-cached); empty when nobody passes tenant=
+        self._tenant_of: Dict[int, str] = {}
+        self._usage: Dict[str, int] = {}
         self._lock = threading.Lock()
 
     @property
@@ -158,7 +186,69 @@ class BlockAllocator:
         """How many blocks cover ``tokens`` cache rows."""
         return -(-tokens // self.block_size)
 
-    def reserve(self, count: int, owner: str) -> List[int]:
+    def tenant_usage(self, tenant: str) -> int:
+        """Blocks currently charged to ``tenant`` (in-use + idle-cached)."""
+        with self._lock:
+            return self._usage.get(tenant, 0)
+
+    def quota_can_fit(self, count: int, tenant: str, quota: Optional[int],
+                      keep: Sequence[int] = ()) -> bool:
+        """Dry-run quota check: could ``reserve(count, tenant=, quota=)``
+        pass the quota gate, counting the tenant's drainable own-cache
+        headroom but EXCLUDING ``keep`` (blocks the caller is about to
+        retain, so the drain could not touch them)?  Side-effect-free —
+        the engine consults this before preempting a victim for a
+        Guarantee head, because preemption cannot cure a quota block."""
+        if quota is None:
+            return True
+        keep_set = set(keep)
+        with self._lock:
+            drainable = sum(
+                1 for b in self._idle
+                if self._tenant_of.get(b) == tenant and b not in keep_set)
+            return (self._usage.get(tenant, 0) - drainable + count
+                    <= quota)
+
+    @property
+    def usage_by_tenant(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._usage)
+
+    def _uncharge_locked(self, block: int) -> None:
+        tenant = self._tenant_of.pop(block, None)
+        if tenant is not None:
+            self._usage[tenant] -= 1
+            if not self._usage[tenant]:
+                del self._usage[tenant]
+
+    def _evict_locked(self, victim: int) -> None:
+        """Detach ``victim`` (and its subtree, via the evictor) from the
+        cache: every released block moves idle -> free and drops its
+        tenant charge.  Caller holds the lock and has verified the
+        victim is idle-cached."""
+        removed = (self.evictor(victim) if self.evictor is not None
+                   else [victim])
+        if victim not in removed:
+            raise RuntimeError(
+                f"evictor did not release victim block {victim}")
+        for b in removed:
+            if b in self._refs or b not in self._idle:
+                raise RuntimeError(
+                    f"evictor released block {b}, which is not "
+                    f"idle-cached (refcount "
+                    f"{self._refs.get(b, 0)}) — index/allocator "
+                    f"state diverged")
+            del self._idle[b]
+            self._cached.discard(b)
+            self._uncharge_locked(b)
+            self._free.append(b)
+            self.evicted_blocks += 1
+
+    def reserve(self, count: int, owner: str,
+                tenant: Optional[str] = None,
+                quota: Optional[int] = None,
+                evict_tenants_first: Optional[Set[str]] = None
+                ) -> List[int]:
         """Hand out ``count`` blocks or fail LOUDLY with the shortfall.
 
         All-or-nothing: a partial grant would leave a request half-
@@ -168,10 +258,55 @@ class BlockAllocator:
         reservation, idle-cached blocks are evicted LRU-first (whole
         subtrees — see class docstring); only a shortfall that survives
         a fully drained cache raises.
+
+        With ``tenant=`` the granted blocks are charged to that tenant;
+        ``quota=`` additionally bounds the tenant's total charge — an
+        over-quota reservation first drains the tenant's OWN idle-cached
+        blocks, then raises :class:`QuotaExceeded` (the pool may still
+        be able to fund OTHER tenants).  ``evict_tenants_first`` biases
+        the shortfall drain toward blocks charged to those tenants
+        (LRU within the preferred set, then plain LRU) — how a
+        Guarantee reservation reclaims Opportunistic cache HBM.
         """
         if count < 1:
             raise ValueError(f"count must be >= 1, got {count}")
         with self._lock:
+            if tenant is not None and quota is not None:
+                used = self._usage.get(tenant, 0)
+                if used + count > quota:
+                    # the tenant's own cache must never wedge its own
+                    # quota: drain its idle-cached blocks (LRU; subtree
+                    # granular, so a mixed-charge subtree may release
+                    # more) — but ONLY when the drain can actually make
+                    # room.  A reservation doomed by the tenant's IN-USE
+                    # blocks raises without touching the cache (the same
+                    # no-wipe discipline as the pool doomed-check below:
+                    # a blocked head retried every tick must not grind
+                    # its tenant's hit rate to zero).
+                    drainable = sum(
+                        1 for b in self._idle
+                        if self._tenant_of.get(b) == tenant)
+                    if used - drainable + count > quota:
+                        raise QuotaExceeded(
+                            f"request {owner!r} needs {count} blocks but "
+                            f"tenant {tenant!r} holds {used - drainable} "
+                            f"in use (+{drainable} cached) of its "
+                            f"{quota}-block quota — over even after a "
+                            f"full own-cache drain"
+                        )
+                    for b in [b for b in self._idle
+                              if self._tenant_of.get(b) == tenant]:
+                        if self._usage.get(tenant, 0) + count <= quota:
+                            break
+                        if b in self._idle:  # prior subtree may cover it
+                            self._evict_locked(b)
+                if self._usage.get(tenant, 0) + count > quota:
+                    raise QuotaExceeded(
+                        f"request {owner!r} needs {count} blocks but "
+                        f"tenant {tenant!r} already holds "
+                        f"{self._usage.get(tenant, 0)} of its "
+                        f"{quota}-block quota"
+                    )
             if count > len(self._free) + len(self._idle):
                 # doomed even after a full drain (eviction conserves
                 # free + idle) — raise WITHOUT wiping the cache, or a
@@ -185,28 +320,23 @@ class BlockAllocator:
                 )
             while count > len(self._free) and self._idle:
                 victim = next(iter(self._idle))
-                removed = (self.evictor(victim) if self.evictor is not None
-                           else [victim])
-                if victim not in removed:
-                    raise RuntimeError(
-                        f"evictor did not release victim block {victim}")
-                for b in removed:
-                    if b in self._refs or b not in self._idle:
-                        raise RuntimeError(
-                            f"evictor released block {b}, which is not "
-                            f"idle-cached (refcount "
-                            f"{self._refs.get(b, 0)}) — index/allocator "
-                            f"state diverged")
-                    del self._idle[b]
-                    self._cached.discard(b)
-                    self._free.append(b)
-                    self.evicted_blocks += 1
+                if evict_tenants_first:
+                    # prefer the coldest idle block charged to a
+                    # preferred-victim tenant; fall back to plain LRU
+                    for b in self._idle:
+                        if self._tenant_of.get(b) in evict_tenants_first:
+                            victim = b
+                            break
+                self._evict_locked(victim)
             # the up-front doomed-check plus the drain loop guarantee
             # the free list can now fund the reservation (eviction
             # conserves free + idle)
             blocks = [self._free.pop() for _ in range(count)]
             for b in blocks:
                 self._refs[b] = 1
+                if tenant is not None:
+                    self._tenant_of[b] = tenant
+                    self._usage[tenant] = self._usage.get(tenant, 0) + 1
             return blocks
 
     def retain(self, blocks: Sequence[int]) -> None:
@@ -244,8 +374,12 @@ class BlockAllocator:
                 if self._refs[b] == 0:
                     del self._refs[b]
                     if b in self._cached:
+                        # parks idle-cached: STILL charged to its tenant
+                        # (the cache occupies that tenant's HBM budget
+                        # until eviction or a free)
                         self._idle[b] = None
                     else:
+                        self._uncharge_locked(b)
                         self._free.append(b)
 
     def mark_cached(self, blocks: Sequence[int]) -> None:
@@ -266,4 +400,5 @@ class BlockAllocator:
             self._cached.discard(block)
             if block in self._idle:
                 del self._idle[block]
+                self._uncharge_locked(block)
                 self._free.append(block)

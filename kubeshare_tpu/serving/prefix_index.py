@@ -9,7 +9,10 @@ pool block that holds exactly those tokens' K/V rows.  At admission the
 engine walks a new prompt down the trie, maps every matched block into
 the slot's page table, and starts prefill at the first uncached token;
 at retirement it inserts the request's prompt blocks so the NEXT
-request can match them.
+request can match them.  The QoS preemption path (engine._preempt)
+inserts a victim's prompt + generated-so-far sequence the same way —
+the trie doesn't distinguish prompt tokens from generated ones, which
+is exactly what makes a preempted request's resume a cache hit.
 
 Granularity rules (all host-side; a lookup walks O(prompt/block_size)
 dict hops plus one tail scan bounded by the children sharing the tail's

@@ -1,0 +1,263 @@
+"""Multi-tenant QoS for the serving engine: tenants, classes, fair queue.
+
+KubeShare's whole point is fractional sharing with Guarantee vs
+Opportunistic classes enforced at runtime (PAPER.md §1, §2.9) — the
+scheduler's ``priority`` label picks the class at placement
+(``scheduler/podspec.py``: priority > 0 is guaranteed, <= 0 is
+opportunistic) and the token daemon enforces device-time shares by
+DECAYED usage (``native/tokend.cc``: ``used_ms`` decays exponentially
+over a window; a pod's share is ``used/window``; starved under-share
+pods go first).  But none of that reaches INSIDE a serving pod: the
+engine's FIFO queue and first-come block pool let any client flood both
+and starve everyone else, so the control plane's shares stop meaning
+anything the moment requests hit the engine.
+
+This module brings the same share semantics into the serving plane:
+
+- :class:`TenantSpec` — a tenant is a named traffic source with a QoS
+  class (mirroring the scheduler's two classes), a fair-share
+  ``weight``, and an optional KV-HBM block quota (the serving-plane twin
+  of the pod's ``gpu_mem`` cap, in pool blocks);
+- :class:`TenantRegistry` — the engine's tenant table; requests name
+  their tenant and unknown names fail loudly at submit;
+- :class:`FairQueue` — a token-weighted fair queue with the decayed
+  virtual-time accounting tokend uses for device time: every prefilled
+  or generated token charges the tenant's service counter, the counter
+  decays exponentially with time constant ``window_s`` (exactly
+  tokend's ``used_ms`` decay), and admission always pulls the head of
+  the tenant with the LOWEST decayed service per unit weight — a
+  deficit-round-robin over tokens instead of bytes.  Guarantee tenants
+  are strictly ahead of Opportunistic tenants (the scheduler's
+  priority-first queue ordering, ``plugin.py`` Less()); within a tenant
+  requests stay FIFO, so the single-tenant engine degenerates to
+  exactly the PR 1 queue.
+
+The queue orders ADMISSION only; enforcement teeth live elsewhere:
+block quotas in :class:`~kubeshare_tpu.serving.kv_blocks.BlockAllocator`
+(per-tenant charge ledger) and preemption in ``engine.py`` (a Guarantee
+admission that cannot be funded retires an Opportunistic decode slot's
+blocks into the prefix index and re-queues it — the radix cache makes
+the preemption nearly free, because the victim later resumes from its
+first uncached token, bit-exactly).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+QOS_GUARANTEE = "guarantee"
+QOS_OPPORTUNISTIC = "opportunistic"
+DEFAULT_TENANT = "default"
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    """One traffic source's QoS contract.
+
+    ``qos_class`` mirrors the scheduler's two classes (podspec.py:
+    priority > 0 -> guarantee, <= 0 -> opportunistic).  ``weight`` is
+    the fair-share weight inside the class (tokens of service are
+    charged per unit weight).  ``kv_block_quota`` caps the pool blocks
+    chargeable to this tenant at once — in-use AND idle-cached blocks
+    it brought in — or None for uncapped."""
+
+    name: str
+    qos_class: str = QOS_GUARANTEE
+    weight: float = 1.0
+    kv_block_quota: Optional[int] = None
+
+    def __post_init__(self) -> None:
+        if not self.name:
+            raise ValueError("tenant name must be non-empty")
+        if self.qos_class not in (QOS_GUARANTEE, QOS_OPPORTUNISTIC):
+            raise ValueError(
+                f"tenant {self.name!r}: qos_class must be "
+                f"{QOS_GUARANTEE!r} or {QOS_OPPORTUNISTIC!r}, got "
+                f"{self.qos_class!r}")
+        if self.weight <= 0:
+            raise ValueError(
+                f"tenant {self.name!r}: weight must be > 0, got "
+                f"{self.weight}")
+        if self.kv_block_quota is not None and self.kv_block_quota < 1:
+            raise ValueError(
+                f"tenant {self.name!r}: kv_block_quota must be >= 1 or "
+                f"None, got {self.kv_block_quota}")
+
+    @property
+    def is_guarantee(self) -> bool:
+        return self.qos_class == QOS_GUARANTEE
+
+
+class TenantRegistry:
+    """The engine's tenant table.  Registration is loud about
+    duplicates, lookup is loud about unknowns — a typo'd tenant name
+    must never silently create an unlimited default."""
+
+    def __init__(self, specs: Optional[List[TenantSpec]] = None) -> None:
+        self._specs: Dict[str, TenantSpec] = {}
+        for spec in specs or []:
+            self.register(spec)
+
+    @classmethod
+    def default(cls) -> "TenantRegistry":
+        """Single-tenant registry: one uncapped Guarantee tenant named
+        ``default`` — the engine's behavior with no QoS config is
+        exactly PR 1's FIFO engine."""
+        return cls([TenantSpec(DEFAULT_TENANT)])
+
+    def register(self, spec: TenantSpec) -> TenantSpec:
+        if spec.name in self._specs:
+            raise ValueError(f"tenant {spec.name!r} already registered")
+        self._specs[spec.name] = spec
+        return spec
+
+    def get(self, name: str) -> TenantSpec:
+        spec = self._specs.get(name)
+        if spec is None:
+            raise KeyError(
+                f"unknown tenant {name!r} (registered: "
+                f"{sorted(self._specs) or 'none'})")
+        return spec
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._specs
+
+    def names(self) -> List[str]:
+        return sorted(self._specs)
+
+    def specs(self) -> List[TenantSpec]:
+        return [self._specs[n] for n in sorted(self._specs)]
+
+    def opportunistic(self) -> List[str]:
+        """Names of opportunistic tenants — the preemption victim set
+        and the Guarantee reservations' preferred eviction source."""
+        return [n for n, s in sorted(self._specs.items())
+                if not s.is_guarantee]
+
+
+class _TenantLane:
+    __slots__ = ("items", "service", "last_decay")
+
+    def __init__(self) -> None:
+        # (seq, item): seq is the FIFO tie-break; requeue_front pushes
+        # with a seq below every live one so a preempted request resumes
+        # ahead of its tenant's later arrivals
+        self.items: Deque[Tuple[int, Any]] = deque()
+        self.service = 0.0     # decayed token-service counter
+        self.last_decay = 0.0  # clock timestamp of the last decay
+
+
+class FairQueue:
+    """Token-weighted fair queue with tokend's decayed-share accounting.
+
+    ``charge(tenant, tokens)`` adds served tokens to the tenant's
+    service counter; the counter decays as ``service * exp(-dt/window)``
+    (tokend's ``ApplyDecay``), so a tenant idle for a while earns its
+    share back instead of being punished forever for a burst.
+    ``order()`` returns the tenants with queued work, Guarantee class
+    strictly first, each class sorted by decayed service per unit
+    weight ascending (FIFO arrival as the tie-break) — the head of the
+    first admissible tenant is what the engine admits next.  Within a
+    tenant, strict FIFO.
+
+    The queue is host-side and single-consumer (the engine's scheduling
+    loop); the engine's own lock discipline covers it."""
+
+    def __init__(self, registry: TenantRegistry, window_s: float = 10.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        self.registry = registry
+        self.window_s = window_s
+        self._clock = clock
+        self._lanes: Dict[str, _TenantLane] = {}
+        self._seq = 0        # back-of-queue sequence (grows)
+        self._front_seq = 0  # front-of-queue sequence (shrinks)
+
+    # ------------------------------------------------------------------
+    def _lane(self, tenant: str) -> _TenantLane:
+        self.registry.get(tenant)  # loud on unknown names
+        lane = self._lanes.get(tenant)
+        if lane is None:
+            lane = self._lanes[tenant] = _TenantLane()
+            lane.last_decay = self._clock()
+        return lane
+
+    def _decayed(self, lane: _TenantLane, now: float) -> float:
+        dt = now - lane.last_decay
+        if dt > 0:
+            lane.service *= math.exp(-dt / self.window_s)
+            lane.last_decay = now
+        return lane.service
+
+    # ------------------------------------------------------------------
+    def push(self, tenant: str, item: Any) -> None:
+        self._lane(tenant).items.append((self._seq, item))
+        self._seq += 1
+
+    def requeue_front(self, tenant: str, item: Any) -> None:
+        """Preemption path: the victim's resume request goes back to the
+        FRONT of its tenant's lane (it was already scheduled once — the
+        tokens it consumed are charged, which is penalty enough)."""
+        self._front_seq -= 1
+        self._lane(tenant).items.appendleft((self._front_seq, item))
+
+    def peek(self, tenant: str) -> Any:
+        return self._lanes[tenant].items[0][1]
+
+    def pop(self, tenant: str) -> Any:
+        return self._lanes[tenant].items.popleft()[1]
+
+    def charge(self, tenant: str, tokens: float) -> None:
+        """Record served tokens against the tenant's decayed share —
+        called by the engine per prefilled chunk width and per accepted
+        decode token (a prefix-cache hit charges only what actually
+        prefilled, so cache-friendly tenants are scheduled sooner, the
+        way tokend charges measured device time, not requested time)."""
+        lane = self._lane(tenant)
+        self._decayed(lane, self._clock())
+        lane.service += float(tokens)
+
+    def normalized_service(self, tenant: str) -> float:
+        """Decayed service per unit weight — the scheduling key (the
+        serving twin of tokend's ``used/window`` share)."""
+        lane = self._lane(tenant)
+        return (self._decayed(lane, self._clock())
+                / self.registry.get(tenant).weight)
+
+    def order(self) -> List[str]:
+        """Tenants with queued work in admission order: Guarantee class
+        first (the scheduler's priority-first Less()), then by decayed
+        service/weight ascending, FIFO arrival as the tie-break."""
+        now = self._clock()
+        keys = []
+        for name, lane in self._lanes.items():
+            if not lane.items:
+                continue
+            spec = self.registry.get(name)
+            keys.append((
+                0 if spec.is_guarantee else 1,
+                self._decayed(lane, now) / spec.weight,
+                lane.items[0][0],
+                name,
+            ))
+        return [k[-1] for k in sorted(keys)]
+
+    def depth(self, tenant: str) -> int:
+        lane = self._lanes.get(tenant)
+        return len(lane.items) if lane is not None else 0
+
+    def depths(self) -> Dict[str, int]:
+        """Queue depth per REGISTERED tenant (zero included — the
+        metrics surface must expose quiet tenants too)."""
+        return {n: self.depth(n) for n in self.registry.names()}
+
+    def __len__(self) -> int:
+        return sum(len(lane.items) for lane in self._lanes.values())
+
+    def __bool__(self) -> bool:
+        return len(self) > 0

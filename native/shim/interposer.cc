@@ -23,9 +23,12 @@
 //    fabricated RESOURCE_EXHAUSTED PJRT_Error without reaching the real
 //    plugin (Gemini rejected over-cap cuMemAlloc the same way).  Set
 //    TPUSHARE_MEM_ENFORCE=soft for log-and-account-only.
-//  * Every allocation path is covered, not just uploads (Gemini capped
-//    every CUDA alloc; SURVEY §7.4 flags client-init preallocation as the
-//    TPU-specific hard part):
+//  * Every PJRT allocation path in the vendored API is covered — uploads
+//    (BufferFromHostBuffer), the async transfer manager, DmaMap,
+//    device-to-device copies, executable outputs, and client-init
+//    preallocation; aliasing views are accounted explicitly at zero size
+//    (Gemini capped every CUDA alloc; SURVEY §7.4 flags client-init
+//    preallocation as the TPU-specific hard part):
 //      - client-init preallocation: a library constructor exports the
 //        XLA allocator-fraction env from TPUSHARE_MEM_FRACTION before the
 //        runtime starts, and PJRT_Client_Create injects memory_fraction /
@@ -36,7 +39,16 @@
 //        charged on first sighting (size via Buffer_OnDeviceSizeInBytes).
 //        An output the broker denies goes on a local OVERFLOW ledger: the
 //        pod is now over cap, so in hard mode every subsequent upload AND
-//        execute is denied until enough buffers are destroyed.
+//        execute is denied until enough buffers are destroyed;
+//      - device-to-device copies: PJRT_Buffer_CopyToDevice allocates a
+//        same-size target buffer, so the copy is charged up front (sized
+//        from the source — the only pre-copy observable) and the target
+//        rides the per-buffer ledger like an upload;
+//      - aliased views: PJRT_Client_CreateViewOfDeviceBuffer wraps memory
+//        some OTHER library allocated (dlpack import) — the view is
+//        recorded at ZERO size so its destroy can never credit bytes the
+//        shim never charged, and an Execute re-sighting can never charge
+//        it as fresh HBM.
 //  * Accounting is symmetric: only buffers this shim charged are credited
 //    back on destroy, by exactly the charged amount — the ledger can
 //    never drift toward zero from buffers it never saw.  Client destroy
@@ -478,6 +490,56 @@ PJRT_Error* HookedDmaMap(PJRT_Client_DmaMap_Args* args) {
     DmaMapped()[args->data] += bytes;
   } else if (err != nullptr && charged) {
     tpushare_mem_request(-bytes);
+  }
+  return err;
+}
+
+// Device-to-device copy: PJRT_Buffer_CopyToDevice allocates a same-size
+// buffer on the destination device — HBM that passes no host->device
+// hook.  The only pre-copy observable is the SOURCE buffer's on-device
+// size, which equals the target's; charge it like an upload (deny
+// before the device allocates) and put the target on the per-buffer
+// ledger so its destroy credits exactly the charge.
+PJRT_Error* (*g_real_copy_to_device)(PJRT_Buffer_CopyToDevice_Args*) =
+    nullptr;
+PJRT_Error* (*g_real_create_view)(
+    PJRT_Client_CreateViewOfDeviceBuffer_Args*) = nullptr;
+
+long long BufferDeviceBytes(PJRT_Buffer* buffer);  // defined below
+
+PJRT_Error* HookedCopyToDevice(PJRT_Buffer_CopyToDevice_Args* args) {
+  if (!g_gated) return g_real_copy_to_device(args);
+  long long bytes = BufferDeviceBytes(args->buffer);
+  bool charged = false;
+  if (bytes > 0 &&
+      !ChargeUploadBytes(bytes, "device-to-device copy", &charged)) {
+    return MakeShimError(
+        PJRT_Error_Code_RESOURCE_EXHAUSTED,
+        "tpushare: HBM cap exceeded: device-to-device copy denied (pod "
+        "over its gpu_mem cap)");
+  }
+  PJRT_Error* err = g_real_copy_to_device(args);
+  if (err == nullptr && charged && args->dst_buffer != nullptr) {
+    std::lock_guard<std::mutex> lock(g_mem_mu);
+    ChargedBuffers()[args->dst_buffer] += bytes;
+  } else if (err != nullptr && charged) {
+    tpushare_mem_request(-bytes);  // copy failed: roll the charge back
+  }
+  return err;
+}
+
+// Aliased view: the wrapped device memory was allocated (and, when it
+// came through a hooked path, already charged) by someone else — a view
+// is explicitly ZERO-size on the ledger.  Recording it at 0 pins two
+// invariants: its destroy credits nothing (the credit>0 guard skips
+// it), and an Execute output re-sighting finds it already accounted and
+// cannot charge it as fresh HBM.
+PJRT_Error* HookedCreateViewOfDeviceBuffer(
+    PJRT_Client_CreateViewOfDeviceBuffer_Args* args) {
+  PJRT_Error* err = g_real_create_view(args);
+  if (g_gated && err == nullptr && args->buffer != nullptr) {
+    std::lock_guard<std::mutex> lock(g_mem_mu);
+    ChargedBuffers().emplace(args->buffer, 0);
   }
   return err;
 }
@@ -986,6 +1048,16 @@ const PJRT_Api* WrapApi(const PJRT_Api* real) {
   }
   if (g_real_dma_unmap != nullptr) {
     wrapped.PJRT_Client_DmaUnmap = HookedDmaUnmap;
+  }
+  // device-to-device copy + aliased-view paths (VERDICT r5 #3/#4)
+  g_real_copy_to_device = wrapped.PJRT_Buffer_CopyToDevice;
+  g_real_create_view = wrapped.PJRT_Client_CreateViewOfDeviceBuffer;
+  if (g_real_copy_to_device != nullptr) {
+    wrapped.PJRT_Buffer_CopyToDevice = HookedCopyToDevice;
+  }
+  if (g_real_create_view != nullptr) {
+    wrapped.PJRT_Client_CreateViewOfDeviceBuffer =
+        HookedCreateViewOfDeviceBuffer;
   }
   // fabricated-error service entries (pass-through for real errors)
   wrapped.PJRT_Error_Destroy = HookedErrorDestroy;

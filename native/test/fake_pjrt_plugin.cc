@@ -47,6 +47,8 @@ std::atomic<int> g_tm_retrieves{0};
 std::atomic<int> g_tm_destroys{0};
 std::atomic<int> g_dma_maps{0};
 std::atomic<int> g_dma_unmaps{0};
+std::atomic<int> g_copy_calls{0};
+std::atomic<int> g_view_calls{0};
 
 int DeviceMs() {
   static int ms = [] {
@@ -359,6 +361,22 @@ PJRT_Error* FakeDmaUnmap(PJRT_Client_DmaUnmap_Args*) {
   return nullptr;
 }
 
+// device-to-device copy / aliased-view surface: handles only — the
+// interposer's charge-on-copy / zero-size-view accounting is under test
+PJRT_Error* FakeCopyToDevice(PJRT_Buffer_CopyToDevice_Args* args) {
+  g_copy_calls++;
+  args->dst_buffer =
+      reinterpret_cast<PJRT_Buffer*>(g_next_handle.fetch_add(16));
+  return nullptr;
+}
+
+PJRT_Error* FakeCreateViewOfDeviceBuffer(
+    PJRT_Client_CreateViewOfDeviceBuffer_Args* args) {
+  g_view_calls++;
+  args->buffer = reinterpret_cast<PJRT_Buffer*>(g_next_handle.fetch_add(16));
+  return nullptr;
+}
+
 }  // namespace
 
 extern "C" {
@@ -376,6 +394,8 @@ int fake_tm_retrieves(void) { return g_tm_retrieves.load(); }
 int fake_tm_destroys(void) { return g_tm_destroys.load(); }
 int fake_dma_maps(void) { return g_dma_maps.load(); }
 int fake_dma_unmaps(void) { return g_dma_unmaps.load(); }
+int fake_copy_calls(void) { return g_copy_calls.load(); }
+int fake_view_calls(void) { return g_view_calls.load(); }
 
 const char* fake_client_create_options(void) {
   static std::string copy;
@@ -413,6 +433,8 @@ const PJRT_Api* GetPjrtApi(void) {
     api.PJRT_AsyncHostToDeviceTransferManager_Destroy = FakeTMDestroy;
     api.PJRT_Client_DmaMap = FakeDmaMap;
     api.PJRT_Client_DmaUnmap = FakeDmaUnmap;
+    api.PJRT_Buffer_CopyToDevice = FakeCopyToDevice;
+    api.PJRT_Client_CreateViewOfDeviceBuffer = FakeCreateViewOfDeviceBuffer;
     initialized = true;
   }
   return &api;

@@ -7,6 +7,11 @@
 //   --upload-bytes B   upload a B-byte f32 array (default 4096); prints
 //                      "upload_ok" or "upload_denied code=<c> msg=<m>"
 //   --keep-buffer      skip the destroy after a successful upload
+//   --copy             CopyToDevice the kept upload (needs --keep-buffer);
+//                      prints "copy_ok"/"copy_denied code=<c>" and destroys
+//                      the copy ("copy_destroyed") unless --keep-copy
+//   --view             CreateViewOfDeviceBuffer (needs --keep-buffer);
+//                      prints "view_ok" then "view_destroyed"
 //   --events           caller-owned completion events: request
 //                      device_complete_events, await + destroy them
 //   --outputs K        pass output_lists with K slots per execute (sets
@@ -79,6 +84,9 @@ int main(int argc, char** argv) {
   long long dma_bytes = 0;
   bool async_no_retrieve = false;
   bool keep_buffer = false;
+  bool do_copy = false;
+  bool keep_copy = false;
+  bool do_view = false;
   bool caller_events = false;
   bool destroy_outputs = false;
   bool create_client = false;
@@ -97,6 +105,12 @@ int main(int argc, char** argv) {
       dma_bytes = std::atoll(argv[++i]);
     } else if (flag == "--keep-buffer") {
       keep_buffer = true;
+    } else if (flag == "--copy") {
+      do_copy = true;
+    } else if (flag == "--keep-copy") {
+      keep_copy = true;
+    } else if (flag == "--view") {
+      do_view = true;
     } else if (flag == "--events") {
       caller_events = true;
     } else if (flag == "--outputs" && i + 1 < argc) {
@@ -310,9 +324,10 @@ int main(int argc, char** argv) {
   }
 
   // one host->device upload of upload_bytes (f32), destroyed again unless
-  // kept: exercises the HBM accounting + hard-denial hooks
-  auto attempt_upload = [&](const char* tag) {
-    if (api->PJRT_Client_BufferFromHostBuffer == nullptr) return;
+  // kept: exercises the HBM accounting + hard-denial hooks.  Returns the
+  // buffer when kept (the --copy/--view source).
+  auto attempt_upload = [&](const char* tag) -> PJRT_Buffer* {
+    if (api->PJRT_Client_BufferFromHostBuffer == nullptr) return nullptr;
     PJRT_Client_BufferFromHostBuffer_Args buffer_args;
     std::memset(&buffer_args, 0, sizeof(buffer_args));
     buffer_args.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
@@ -326,19 +341,80 @@ int main(int argc, char** argv) {
                   static_cast<int>(ErrorCode(api, err)),
                   ErrorMessage(api, err).c_str());
       DestroyError(api, err);
+      return nullptr;
+    }
+    std::printf("%s_ok\n", tag);
+    if (!keep_buffer && api->PJRT_Buffer_Destroy != nullptr &&
+        buffer_args.buffer != nullptr) {
+      PJRT_Buffer_Destroy_Args destroy_args;
+      std::memset(&destroy_args, 0, sizeof(destroy_args));
+      destroy_args.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+      destroy_args.buffer = buffer_args.buffer;
+      api->PJRT_Buffer_Destroy(&destroy_args);
+      return nullptr;
+    }
+    return buffer_args.buffer;
+  };
+  PJRT_Buffer* uploaded = attempt_upload("upload");
+
+  auto destroy_buffer = [&](PJRT_Buffer* buffer) {
+    if (buffer == nullptr || api->PJRT_Buffer_Destroy == nullptr) return;
+    PJRT_Buffer_Destroy_Args destroy_args;
+    std::memset(&destroy_args, 0, sizeof(destroy_args));
+    destroy_args.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    destroy_args.buffer = buffer;
+    api->PJRT_Buffer_Destroy(&destroy_args);
+  };
+
+  // device-to-device copy (--copy; needs --keep-buffer for a source):
+  // the copy target is fresh HBM the interposer must charge (sized from
+  // the source, which on the fake plugin reports FAKE_OUTPUT_BYTES)
+  if (do_copy && uploaded != nullptr &&
+      api->PJRT_Buffer_CopyToDevice != nullptr) {
+    PJRT_Buffer_CopyToDevice_Args cargs;
+    std::memset(&cargs, 0, sizeof(cargs));
+    cargs.struct_size = PJRT_Buffer_CopyToDevice_Args_STRUCT_SIZE;
+    cargs.buffer = uploaded;
+    PJRT_Error* err = api->PJRT_Buffer_CopyToDevice(&cargs);
+    if (err != nullptr) {
+      std::printf("copy_denied code=%d msg=%s\n",
+                  static_cast<int>(ErrorCode(api, err)),
+                  ErrorMessage(api, err).c_str());
+      DestroyError(api, err);
     } else {
-      std::printf("%s_ok\n", tag);
-      if (!keep_buffer && api->PJRT_Buffer_Destroy != nullptr &&
-          buffer_args.buffer != nullptr) {
-        PJRT_Buffer_Destroy_Args destroy_args;
-        std::memset(&destroy_args, 0, sizeof(destroy_args));
-        destroy_args.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
-        destroy_args.buffer = buffer_args.buffer;
-        api->PJRT_Buffer_Destroy(&destroy_args);
+      std::printf("copy_ok\n");
+      if (!keep_copy) {
+        destroy_buffer(cargs.dst_buffer);
+        std::printf("copy_destroyed\n");
       }
     }
-  };
-  attempt_upload("upload");
+  }
+
+  // aliased view (--view; needs --keep-buffer): wraps existing device
+  // memory — the interposer must account it at ZERO size (its destroy
+  // credits nothing)
+  if (do_view && uploaded != nullptr &&
+      api->PJRT_Client_CreateViewOfDeviceBuffer != nullptr) {
+    PJRT_Client_CreateViewOfDeviceBuffer_Args vargs;
+    std::memset(&vargs, 0, sizeof(vargs));
+    vargs.struct_size = PJRT_Client_CreateViewOfDeviceBuffer_Args_STRUCT_SIZE;
+    static char view_region[16];  // identity only; the fake never reads it
+    vargs.device_buffer_ptr = view_region;
+    int64_t vdims[1] = {4};
+    vargs.dims = vdims;
+    vargs.num_dims = 1;
+    vargs.element_type = PJRT_Buffer_Type_F32;
+    PJRT_Error* err = api->PJRT_Client_CreateViewOfDeviceBuffer(&vargs);
+    if (err != nullptr) {
+      std::printf("view_denied code=%d\n",
+                  static_cast<int>(ErrorCode(api, err)));
+      DestroyError(api, err);
+    } else {
+      std::printf("view_ok\n");
+      destroy_buffer(vargs.buffer);
+      std::printf("view_destroyed\n");
+    }
+  }
 
   if (destroy_client && api->PJRT_Client_Destroy != nullptr) {
     PJRT_Client_Destroy_Args destroy_args;
