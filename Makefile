@@ -6,6 +6,7 @@
 #   make serve-smoke     continuous-batching serving bench, fast CPU path
 #   make serve-prefix-smoke  prefix-cache on/off serving bench, fast CPU path
 #   make serve-qos-smoke multi-tenant QoS serving bench, fast CPU path
+#   make serve-mixed-smoke  stall-free mixed batching on/off bench, fast CPU path
 #   make images          build the kubeshare-tpu:latest container image
 #   make image-check     validate everything the Dockerfile needs, sans docker
 #   make e2e-kind        kind-based end-to-end (skips cleanly without kind)
@@ -13,7 +14,7 @@
 IMAGE ?= kubeshare-tpu:latest
 DOCKER ?= $(shell command -v docker || command -v podman)
 
-.PHONY: all native test serve-smoke serve-prefix-smoke serve-qos-smoke images image-check e2e-kind tsan clean
+.PHONY: all native test serve-smoke serve-prefix-smoke serve-qos-smoke serve-mixed-smoke images image-check e2e-kind tsan clean
 
 all: native
 
@@ -34,6 +35,9 @@ serve-prefix-smoke:
 
 serve-qos-smoke:
 	JAX_PLATFORMS=cpu python3 benchmarks/serving_bench.py --multi-tenant --smoke
+
+serve-mixed-smoke:
+	JAX_PLATFORMS=cpu python3 benchmarks/serving_bench.py --mixed --smoke
 
 images: image-check
 ifeq ($(strip $(DOCKER)),)

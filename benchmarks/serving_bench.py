@@ -48,6 +48,17 @@ Methodology (the serving section of docs/perf.md records results):
   serving metrics families (the collector-plane scrape surface), not
   from bench-side arithmetic.
 
+- ``--mixed`` switches to the STALL-FREE MIXED BATCHING comparison: one
+  long-prompt/decode-mix trace (a short-prompt long-decode background
+  keeps lanes decoding while a fraction of requests bring multi-chunk
+  prompts) replayed against the same engine geometry with mixed
+  batching on vs off — identical pool, identical KV-HBM budget, so the
+  ratio isolates exactly what fusing a bounded prefill chunk into the
+  decode dispatch buys.  Headline numbers: time-between-tokens p50/p99
+  (read back through the metrics plane's per-class TBT histogram, not
+  bench-side arithmetic) and aggregate tokens/s — and a hard assert
+  that every request's stream is bit-exact between the two schedulers.
+
 Run:
 
 - ``--multi-tenant`` switches to the QoS comparison: one merged trace
@@ -69,7 +80,9 @@ Run:
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --shared-prefix --smoke
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --multi-tenant
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --multi-tenant --smoke
-    make serve-smoke serve-prefix-smoke serve-qos-smoke
+    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --mixed
+    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --mixed --smoke
+    make serve-smoke serve-prefix-smoke serve-qos-smoke serve-mixed-smoke
 """
 
 from __future__ import annotations
@@ -96,13 +109,15 @@ def smoke_settings() -> dict:
     """Seconds-fast CPU path (CI, tests/test_serving.py).
     KV budget: rtc_batch 4 x max_seq 96 = 384 rows = 48 blocks x 8
     (finer blocks pack the budget tighter — less internal
-    fragmentation per request than coarse blocks would leave)."""
+    fragmentation per request than coarse blocks would leave).
+    One layer and a 16-wide chunk: the smokes lock mechanics, not
+    ratios, and jit compiles dominate their CI bill."""
     return dict(
-        d_model=128, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=256,
+        d_model=128, n_layers=1, n_heads=4, n_kv_heads=2, d_ff=256,
         vocab_size=512, max_seq_len=96,
         num_requests=24, rtc_batch=4,
         num_slots=6, block_size=8, num_blocks=49,
-        max_request_len=96, prefill_chunk=32,
+        max_request_len=96, prefill_chunk=16,
         prompt_lo=8, prompt_hi=64, new_lo=4, new_hi=32,
         mean_interarrival_s=0.0005, seed=0,
     )
@@ -130,11 +145,11 @@ def shared_smoke_settings() -> dict:
     NOT a block multiple (block_size 8), so every hit ends mid-block
     and the copy-on-write dispatch runs in CI too."""
     return dict(
-        d_model=128, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=256,
+        d_model=128, n_layers=1, n_heads=4, n_kv_heads=2, d_ff=256,
         vocab_size=512, max_seq_len=96,
         num_requests=20,
         num_slots=4, block_size=8, num_blocks=49,
-        max_request_len=96, prefill_chunk=32,
+        max_request_len=96, prefill_chunk=16,
         prompt_lo=8, prompt_hi=64, new_lo=4, new_hi=16,
         shared_fraction=0.6, prefix_len=44, tail_lo=4, tail_hi=16,
         mean_interarrival_s=0.01, seed=0,
@@ -164,10 +179,10 @@ def qos_smoke_settings() -> dict:
     Guarantee tenant's steady stream under an Opportunistic flood that
     arrives all at once and would soak every slot and block FIFO."""
     return dict(
-        d_model=128, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=256,
+        d_model=128, n_layers=1, n_heads=4, n_kv_heads=2, d_ff=256,
         vocab_size=512, max_seq_len=96,
         num_slots=4, block_size=8, num_blocks=49,  # 48 blocks = 384 rows
-        max_request_len=96, prefill_chunk=32,
+        max_request_len=96, prefill_chunk=16,
         g_requests=6, g_prompt_lo=8, g_prompt_hi=32,
         g_new_lo=8, g_new_hi=16, g_mean_interarrival_s=0.02,
         # long-decode flood: every slot a flood request grabs stays busy
@@ -197,6 +212,75 @@ def qos_settings() -> dict:
         o_quota_blocks=120,  # enough to soak all slots, not the pool
         seed=0,
     )
+
+
+def mixed_smoke_settings() -> dict:
+    """Seconds-fast long-prompt/decode-mix path (CI,
+    tests/test_serving.py): a short-prompt long-decode background keeps
+    every lane decoding while every ~4th request brings a multi-chunk
+    prompt — the traffic shape whose chunk dispatches stall every lane
+    under the either/or scheduler."""
+    return dict(
+        d_model=128, n_layers=1, n_heads=4, n_kv_heads=2, d_ff=256,
+        vocab_size=512, max_seq_len=192,
+        num_requests=20,
+        num_slots=5, block_size=8, num_blocks=121,  # 120 blocks = 960 rows
+        max_request_len=192, prefill_chunk=16,
+        short_prompt_lo=8, short_prompt_hi=24,
+        short_new_lo=24, short_new_hi=40,
+        long_fraction=0.25, long_prompt_lo=96, long_prompt_hi=160,
+        long_new_lo=4, long_new_hi=12,
+        mean_interarrival_s=0.02, seed=0,
+    )
+
+
+def mixed_settings() -> dict:
+    """The mixed-batching capture configuration (acceptance shape): the
+    full-bench model; one in eight requests brings a 3-5-chunk ingest
+    prompt into a saturated pool of long-decode streamers.  decode_span
+    2 keeps the decode cadence fine-grained — exactly the regime where
+    the either/or scheduler's chunk stalls dominate the streamers' TBT
+    tail and per-dispatch overhead is worth fusing away."""
+    return dict(
+        d_model=256, n_layers=4, n_heads=8, n_kv_heads=2, d_ff=1024,
+        vocab_size=4096, max_seq_len=320,
+        num_requests=96,
+        num_slots=6, block_size=16, num_blocks=121,  # 120 blocks
+        max_request_len=320, prefill_chunk=64, decode_span=2,
+        short_prompt_lo=16, short_prompt_hi=48,
+        short_new_lo=96, short_new_hi=128,
+        long_fraction=0.125, long_prompt_lo=192, long_prompt_hi=288,
+        long_new_lo=8, long_new_hi=16,
+        mean_interarrival_s=0.01, seed=0,
+    )
+
+
+def build_mixed_workload(s: dict):
+    """Long-prompt/decode-mix trace: ``long_fraction`` of requests
+    carry a multi-chunk prompt (and few output tokens — ingest-heavy
+    traffic); the rest are short-prompt long-decode streamers whose
+    inter-token latency the mixed scheduler protects.  Returns
+    (trace, long_rids)."""
+    rng = np.random.default_rng(s["seed"])
+    trace, longs = [], set()
+    t = 0.0
+    for i in range(s["num_requests"]):
+        t += float(rng.exponential(s["mean_interarrival_s"]))
+        rid = f"req{i}"
+        if rng.random() < s["long_fraction"]:
+            prompt_len = int(rng.integers(
+                s["long_prompt_lo"], s["long_prompt_hi"] + 1))
+            max_new = int(rng.integers(
+                s["long_new_lo"], s["long_new_hi"] + 1))
+            longs.add(rid)
+        else:
+            prompt_len = int(rng.integers(
+                s["short_prompt_lo"], s["short_prompt_hi"] + 1))
+            max_new = int(rng.integers(
+                s["short_new_lo"], s["short_new_hi"] + 1))
+        prompt = rng.integers(0, s["vocab_size"], prompt_len).astype(np.int32)
+        trace.append((rid, prompt, max_new, t))
+    return trace, longs
 
 
 def build_qos_workload(s: dict):
@@ -275,21 +359,71 @@ def build_shared_workload(s: dict):
     return trace, sharers
 
 
+def _bench_model(s: dict):
+    """The bench model every suite shares: config + initialized params
+    from one settings dict (one definition — a drifted copy would
+    silently benchmark a different model)."""
+    from kubeshare_tpu.models.transformer import (
+        TransformerConfig, transformer_init)
+
+    config = TransformerConfig(
+        vocab_size=s["vocab_size"], d_model=s["d_model"],
+        n_heads=s["n_heads"], n_kv_heads=s["n_kv_heads"],
+        n_layers=s["n_layers"], d_ff=s["d_ff"],
+        max_seq_len=s["max_seq_len"], dtype=jnp.float32,
+        positional="rope", attention="reference")
+    return config, transformer_init(jax.random.PRNGKey(s["seed"]), config)
+
+
 def _percentiles(values, ps=(50, 95)):
     if not values:
         return {f"p{p}": None for p in ps}
     return {f"p{p}": float(np.percentile(np.asarray(values), p)) for p in ps}
 
 
+def _metric_histogram(metric: dict, name: str):
+    """Merge one promtext histogram family's ``_bucket`` series
+    (across label sets, e.g. the per-QoS-class TBT series) into a
+    sorted [(le, cumulative_count)] list — same-le cumulative counts
+    add, so the merge is itself a valid cumulative histogram."""
+    buckets = {}
+    for (n, labels), v in metric.items():
+        if n != name + "_bucket":
+            continue
+        le = dict(labels)["le"]
+        le = float("inf") if le == "+Inf" else float(le)
+        buckets[le] = buckets.get(le, 0) + v
+    return sorted(buckets.items())
+
+
+def _hist_quantile(buckets, q: float):
+    """PromQL-style histogram_quantile over merged cumulative buckets:
+    linear interpolation inside the covering bucket; a quantile landing
+    in the +Inf tail returns the highest finite bound."""
+    if not buckets or buckets[-1][1] <= 0:
+        return None
+    target = q * buckets[-1][1]
+    prev_le, prev_cum = 0.0, 0.0
+    for le, cum in buckets:
+        if cum >= target:
+            if le == float("inf"):
+                return prev_le
+            return prev_le + (le - prev_le) * (target - prev_cum) / max(
+                1e-12, cum - prev_cum)
+        prev_le, prev_cum = le, cum
+    return prev_le
+
+
 def run_continuous(params, config, s: dict, trace,
                    prefix_cache: bool = True, registry=None,
-                   tenant_of=None) -> dict:
+                   tenant_of=None, mixed: bool = True) -> dict:
     from kubeshare_tpu.serving import EngineConfig, Request, ServingEngine
 
     engine = ServingEngine(params, config, EngineConfig(
         num_slots=s["num_slots"], block_size=s["block_size"],
         num_blocks=s["num_blocks"], max_request_len=s["max_request_len"],
-        prefill_chunk=s["prefill_chunk"], prefix_cache=prefix_cache),
+        prefill_chunk=s["prefill_chunk"], prefix_cache=prefix_cache,
+        mixed=mixed, decode_span=s.get("decode_span", 4)),
         tenants=registry)
     engine.warmup()
     compiles_before = engine.compile_counts()
@@ -336,14 +470,23 @@ def run_continuous(params, config, s: dict, trace,
         labels[0][1]: int(v)
         for (name, labels), v in metric.items()
         if name == "kubeshare_serving_preemptions_total"}
+    # time-between-tokens: read back through the metrics plane's TBT
+    # histogram (the same series Prometheus scrapes), quantiles
+    # estimated PromQL-style — per-token timestamps exist only there
+    tbt_buckets = _metric_histogram(metric, "kubeshare_serving_tbt_seconds")
     return {
         "tokens_per_s": useful / elapsed,
         "useful_tokens": useful,
         "elapsed_s": elapsed,
         "ttft_s": _percentiles(ttfts),
         "per_token_s": _percentiles(per_token),
+        "tbt_s": {"p50": _hist_quantile(tbt_buckets, 0.50),
+                  "p99": _hist_quantile(tbt_buckets, 0.99)},
         "decode_steps": engine.decode_steps,
         "prefill_chunks": engine.prefill_chunks,
+        "mixed_steps": int(metric[
+            ("kubeshare_serving_dispatches_total",
+             (("kind", "mixed"),))]),
         "kv_hbm_bytes_peak": engine.peak_blocks_in_use
         * engine.pool.bytes_per_block(),
         "prefix_hit_tokens": int(metric[
@@ -434,16 +577,7 @@ def run_rtc(params, config, s: dict, trace) -> dict:
 
 
 def run_bench(s: dict) -> dict:
-    from kubeshare_tpu.models.transformer import (
-        TransformerConfig, transformer_init)
-
-    config = TransformerConfig(
-        vocab_size=s["vocab_size"], d_model=s["d_model"],
-        n_heads=s["n_heads"], n_kv_heads=s["n_kv_heads"],
-        n_layers=s["n_layers"], d_ff=s["d_ff"],
-        max_seq_len=s["max_seq_len"], dtype=jnp.float32,
-        positional="rope", attention="reference")
-    params = transformer_init(jax.random.PRNGKey(s["seed"]), config)
+    config, params = _bench_model(s)
     # the comparison is KV-HBM-budgeted: both servers cache into the
     # same number of rows (paging turns the saved worst-case reservation
     # into extra concurrent slots)
@@ -489,16 +623,7 @@ def run_shared_bench(s: dict) -> dict:
     """Prefix cache ON vs OFF on one shared-prefix trace: same engine
     geometry, same pool, same KV-HBM budget — the ratio isolates the
     radix cache (admission matching + CoW + LRU eviction) alone."""
-    from kubeshare_tpu.models.transformer import (
-        TransformerConfig, transformer_init)
-
-    config = TransformerConfig(
-        vocab_size=s["vocab_size"], d_model=s["d_model"],
-        n_heads=s["n_heads"], n_kv_heads=s["n_kv_heads"],
-        n_layers=s["n_layers"], d_ff=s["d_ff"],
-        max_seq_len=s["max_seq_len"], dtype=jnp.float32,
-        positional="rope", attention="reference")
-    params = transformer_init(jax.random.PRNGKey(s["seed"]), config)
+    config, params = _bench_model(s)
     trace, sharers = build_shared_workload(s)
 
     cached = run_continuous(params, config, s, trace, prefix_cache=True)
@@ -529,6 +654,79 @@ def run_shared_bench(s: dict) -> dict:
         "ttft_p50_ratio": uncached["ttft_s"]["p50"]
         / max(1e-9, cached["ttft_s"]["p50"]),
         "prefix_tokens_skipped_fraction": skipped_fraction,
+        "recompiles_after_warmup": recompiles,
+        "platform": jax.default_backend(),
+    }
+
+
+def run_mixed_bench(s: dict, aba: bool = True) -> dict:
+    """Mixed batching ON vs OFF on one long-prompt/decode-mix trace:
+    same engine geometry, same pool, same KV-HBM budget — the ratio
+    isolates exactly what fusing a bounded prefill chunk into the
+    decode dispatch buys.  The acceptance bar (full settings): TBT p99
+    measurably LOWER with mixed on at equal-or-better aggregate
+    tokens/s, every stream bit-exact between the two schedulers, zero
+    recompiles after warmup.  ``aba=False`` drops the second bracketing
+    unmixed run (tests lock mechanics, not timing — one run cheaper)."""
+    config, params = _bench_model(s)
+    trace, longs = build_mixed_workload(s)
+
+    # ABA bracket: the FIRST trace run in a process pays one-time host
+    # costs (allocator growth, page-cache faults) that would be
+    # misattributed to whichever arm runs first — so the mixed run is
+    # bracketed by two unmixed runs and compared against their mean.
+    # Both unmixed runs emit identical streams and dispatch counts
+    # (scheduling is deterministic); only wall time drifts.
+    off_a = run_continuous(params, config, s, trace, mixed=False)
+    on = run_continuous(params, config, s, trace, mixed=True)
+    off_b = (run_continuous(params, config, s, trace, mixed=False)
+             if aba else off_a)
+    recompiles = (on.pop("recompiles") + off_a.pop("recompiles")
+                  + (off_b.pop("recompiles") if aba else 0))
+    if recompiles:
+        raise RuntimeError(
+            f"{recompiles} recompilations after warmup — a static-shape "
+            f"leak; the comparison (and a TPU serving pod) is invalid")
+    # fused-dispatch correctness, end to end: the streams must be
+    # IDENTICAL with and without mixed scheduling — fusing a prefill
+    # chunk into the decode dispatch may not change a single token
+    mismatched = [
+        rid for rid in on["requests"]
+        if on["requests"][rid]["tokens"] != off_a["requests"][rid]["tokens"]
+        or on["requests"][rid]["tokens"] != off_b["requests"][rid]["tokens"]]
+    if mismatched:
+        raise RuntimeError(
+            f"streams diverged between mixed and unmixed for "
+            f"{mismatched} — the fused dispatch is NOT bit-exact")
+    # the decode lanes whose tail the fused dispatch protects: TBT of
+    # the short-prompt streamers, computed per arm from the metrics
+    # plane (tbt_s above); per-request wall stats come from the records
+    on.pop("requests")
+    off_a.pop("requests")
+    if aba:
+        off_b.pop("requests")
+    off_tps = (off_a["tokens_per_s"] + off_b["tokens_per_s"]) / 2
+    off_p50 = (off_a["tbt_s"]["p50"] + off_b["tbt_s"]["p50"]) / 2
+    off_p99 = (off_a["tbt_s"]["p99"] + off_b["tbt_s"]["p99"]) / 2
+    return {
+        "suite": "serving-mixed",
+        "metric": "mixed-on tokens/s over mixed-off tokens/s and "
+                  "time-between-tokens p50/p99 (same long-prompt/"
+                  "decode-mix Poisson trace, same engine geometry and "
+                  "KV-HBM budget; TBT read through the metrics plane; "
+                  "unmixed = mean of the two bracketing runs)",
+        "settings": {k: v for k, v in s.items()},
+        "long_requests": len(longs),
+        "mixed": on,
+        "unmixed_first": off_a,
+        "unmixed_last": off_b,
+        "unmixed": {"tokens_per_s": off_tps,
+                    "tbt_s": {"p50": off_p50, "p99": off_p99},
+                    "mixed_steps": off_a["mixed_steps"]},
+        "tokens_per_s_ratio": on["tokens_per_s"] / max(1e-9, off_tps),
+        "tbt_p50_ratio": off_p50 / max(1e-9, on["tbt_s"]["p50"]),
+        "tbt_p99_ratio": off_p99 / max(1e-9, on["tbt_s"]["p99"]),
+        "streams_bit_exact": True,
         "recompiles_after_warmup": recompiles,
         "platform": jax.default_backend(),
     }
@@ -571,18 +769,10 @@ def run_qos_bench(s: dict) -> dict:
     every request's stream is bit-exact across qos_on/qos_off (preempted
     requests resume via the prefix cache); zero recompiles after warmup.
     """
-    from kubeshare_tpu.models.transformer import (
-        TransformerConfig, transformer_init)
     from kubeshare_tpu.serving import (QOS_OPPORTUNISTIC, TenantRegistry,
                                        TenantSpec)
 
-    config = TransformerConfig(
-        vocab_size=s["vocab_size"], d_model=s["d_model"],
-        n_heads=s["n_heads"], n_kv_heads=s["n_kv_heads"],
-        n_layers=s["n_layers"], d_ff=s["d_ff"],
-        max_seq_len=s["max_seq_len"], dtype=jnp.float32,
-        positional="rope", attention="reference")
-    params = transformer_init(jax.random.PRNGKey(s["seed"]), config)
+    config, params = _bench_model(s)
     trace, tenant_of = build_qos_workload(s)
     g_trace = [e for e in trace if tenant_of[e[0]] == "prod"]
 
@@ -660,9 +850,15 @@ def main() -> None:
     parser.add_argument("--multi-tenant", action="store_true",
                         help="QoS comparison: Guarantee tenant + "
                              "Opportunistic flood at one KV-HBM budget")
+    parser.add_argument("--mixed", action="store_true",
+                        help="stall-free mixed batching on/off on a "
+                             "long-prompt/decode-mix trace")
     parser.add_argument("--json", help="write the result JSON here too")
     args = parser.parse_args()
-    if args.multi_tenant:
+    if args.mixed:
+        result = run_mixed_bench(
+            mixed_smoke_settings() if args.smoke else mixed_settings())
+    elif args.multi_tenant:
         result = run_qos_bench(
             qos_smoke_settings() if args.smoke else qos_settings())
     elif args.shared_prefix:
@@ -676,6 +872,18 @@ def main() -> None:
     if args.json:
         with open(args.json, "w") as f:
             f.write(text + "\n")
+    if args.mixed:
+        on, off = result["mixed"], result["unmixed"]
+        print(f"\nmixed batching: TBT p99 "
+              f"{1e3 * on['tbt_s']['p99']:.1f} ms vs "
+              f"{1e3 * off['tbt_s']['p99']:.1f} ms unmixed "
+              f"({result['tbt_p99_ratio']:.2f}x lower, target > 1x on "
+              f"the full workload); TBT p50 "
+              f"{result['tbt_p50_ratio']:.2f}x lower; tokens/s ratio "
+              f"{result['tokens_per_s_ratio']:.3f} (target >= 1.0); "
+              f"{on['mixed_steps']} fused dispatches; streams bit-exact",
+              file=sys.stderr)
+        return
     if args.multi_tenant:
         print(f"\nguarantee retention under flood: "
               f"{result['guarantee_retention']:.3f} (target >= 0.8); "

@@ -11,7 +11,9 @@ this framework adds):
     request actually admitted, not `max_seq_len` per slot
   - the continuous-batching engine (`serving/engine.py`): mixed-length
     requests queue through a static slot pool — admitted mid-flight into
-    freed slots, chunked prefill interleaved with batched decode spans,
+    freed slots, chunked prefill FUSED into the decode dispatch
+    (stall-free mixed batching: in-flight streams never wait behind a
+    prompt, and the fused chunk is bounded by `mixed_prefill_budget`),
     retired on max-tokens with their blocks recycled — zero
     recompilation after warmup
   - every XLA dispatch gated through the native token runtime exactly as
@@ -73,7 +75,12 @@ def main() -> None:
     params = transformer_init(jax.random.PRNGKey(0), config)
     engine_config = EngineConfig(
         num_slots=4, block_size=16, num_blocks=33,  # 32 blocks = 512 rows
-        max_request_len=192, prefill_chunk=32, decode_span=4)
+        max_request_len=192, prefill_chunk=32, decode_span=4,
+        # stall-free mixed batching (the default, spelled out): a prod
+        # admission's prefill chunks ride the decode dispatch — capped
+        # at 16 fused prefill tokens per step, the bound on the extra
+        # latency any in-flight stream pays per admission
+        mixed=True, mixed_prefill_budget=16)
     dense_bytes = (2 * config.n_layers * engine_config.num_slots
                    * config.kv_heads * config.max_seq_len
                    * config.head_dim * 4)
@@ -193,6 +200,12 @@ def main() -> None:
               f"{engine.prefix_hit_tokens} prompt tokens skipped, "
               f"{engine.cow_copies} CoW copies, "
               f"{engine.allocator.cached_idle_blocks} blocks cached idle")
+        print(f"mixed batching: {engine.mixed_steps} fused dispatches "
+              f"(prefill chunks that rode a decode span instead of "
+              f"stalling it), {engine.prefill_chunks - engine.mixed_steps}"
+              f" standalone chunks, "
+              f"{engine.decode_steps - engine.mixed_steps} standalone "
+              f"spans")
         if recompiles:
             raise RuntimeError(
                 f"{recompiles} recompilations after warmup — static-shape "

@@ -12,10 +12,14 @@ The serving subsystem the fractional-chip runtime was built to host:
   at its OWN length;
 - :mod:`engine` — the continuous-batching engine: one jitted step over a
   static pool of S slots with an active mask, admitting queued requests
-  into freed slots mid-flight, interleaving chunked prefill with batched
-  decode, retiring slots on EOS/max-tokens and recycling their blocks —
-  zero recompilation after warmup, every dispatch chargeable through the
-  :class:`~kubeshare_tpu.isolation.ExecutionGuard` token path;
+  into freed slots mid-flight, FUSING a budget-bounded prefill chunk
+  into the decode dispatch whenever both phases have work (stall-free
+  mixed batching — decode lanes never wait behind a long prompt),
+  retiring slots on EOS/max-tokens and recycling their blocks — zero
+  recompilation after warmup, every dispatch chargeable through the
+  :class:`~kubeshare_tpu.isolation.ExecutionGuard` token path, and the
+  device sync guard-only so an unguarded engine pipelines one step
+  ahead;
 - :mod:`prefix_index` — the radix-tree prefix cache over the pool:
   retired prompts' blocks become content-addressable, admission maps
   matched blocks straight into a new slot's page table (refcounted
@@ -36,8 +40,8 @@ from .engine import (EngineConfig, Request, RequestResult, ServingEngine,
                      plan_prefill_chunks)
 from .kv_blocks import (BlockAllocator, BlockExhausted, PagedKVPool,
                         QuotaExceeded, init_paged_pool)
-from .paged import (paged_copy_block, paged_decode_step, paged_gather_kv,
-                    paged_prefill_step)
+from .paged import (paged_copy_block, paged_decode_span, paged_decode_step,
+                    paged_gather_kv, paged_mixed_step, paged_prefill_step)
 from .prefix_index import PrefixIndex
 from .qos import (DEFAULT_TENANT, QOS_GUARANTEE, QOS_OPPORTUNISTIC,
                   FairQueue, TenantRegistry, TenantSpec)
@@ -60,8 +64,10 @@ __all__ = [
     "TenantSpec",
     "init_paged_pool",
     "paged_copy_block",
+    "paged_decode_span",
     "paged_decode_step",
     "paged_gather_kv",
+    "paged_mixed_step",
     "paged_prefill_step",
     "plan_prefill_chunks",
 ]

@@ -15,13 +15,32 @@ schedules at TOKEN granularity instead:
   pool cannot fund queues the request rather than clamping anything;
 - prompts prefill in fixed-width chunks (widths bucketed to powers of
   two, so ragged prompts hit O(log chunk) compiled shapes, not one per
-  remainder), scheduled ahead of decode (the Orca discipline — a fuller
-  slot pool makes every static-width decode step denser, and TTFT is
-  bounded by chunks, not batch barriers);
+  remainder), filling slots rotating round-robin so one many-chunk
+  prompt cannot monopolize prefill ticks;
 - decode advances every active slot ``decode_span`` tokens per dispatch
   (a lax.scan of step-identical iterations; lanes self-deactivate on
   budget/EOS) — dispatch overhead amortized the way the PyGraph line of
   work batches GPU launches;
+- STALL-FREE MIXED BATCHING (on by default): when prefill and decode
+  work coexist, one fused dispatch (paged.paged_mixed_step) advances
+  every decode lane by its span AND consumes one prefill chunk bounded
+  by ``mixed_prefill_budget`` tokens — decode lanes never wait behind a
+  long prompt (the either/or Orca discipline stalls every in-flight
+  lane for every chunk, spiking inter-token latency across all
+  tenants), and a fused step pays ONE launch where the split path pays
+  two.  Chunks wider than the budget are sliced to already-warmed
+  power-of-two pieces, so the added latency any decode lane (a
+  Guarantee tenant's included) pays per admission ride-along is
+  bounded by the budget — and warmup covers one mixed shape per
+  existing prefill bucket, preserving the zero-recompile invariant.
+  Streams are bit-exact with ``mixed=False`` (the fused program is a
+  composition of the unchanged prefill/decode entry points over
+  disjoint writable blocks — test- and bench-hard-asserted);
+- host/device overlap: dispatches synchronize ONLY when charging an
+  ExecutionGuard (token accounting needs measured wall time);
+  unguarded, the engine pipelines one step ahead — admission and the
+  caller's arrival loop run while the device executes, and emitted
+  tokens are read when the next step consumes them;
 - slots retire on EOS / max-tokens; their blocks drop their reference
   and the next queued request takes them over;
 - a radix-tree PREFIX CACHE (prefix_index.py) makes retired prompts'
@@ -78,7 +97,8 @@ from ..utils.promtext import (MetricFamily, MetricServer, Sample,
                               _format_value)
 from .kv_blocks import (BlockAllocator, BlockExhausted, QuotaExceeded,
                         init_paged_pool)
-from .paged import paged_copy_block, paged_decode_step, paged_prefill_step
+from .paged import (paged_copy_block, paged_decode_span, paged_mixed_step,
+                    paged_prefill_step)
 from .prefix_index import PrefixIndex
 from .qos import (DEFAULT_TENANT, QOS_GUARANTEE, QOS_OPPORTUNISTIC,
                   FairQueue, TenantRegistry, TenantSpec)
@@ -87,24 +107,32 @@ from .qos import (DEFAULT_TENANT, QOS_GUARANTEE, QOS_OPPORTUNISTIC,
 # — spans sub-chunk CPU smoke latencies up to badly queued tail requests.
 TTFT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
                 10.0)
+# Inter-token-latency (time-between-tokens) bucket bounds: an order of
+# magnitude finer than TTFT — a healthy decode lane emits every few ms,
+# and the tail the mixed scheduler exists to fix (a lane stalled behind
+# a multi-chunk prompt) shows up in the 100ms..1s slots.
+TBT_BUCKETS = (0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+               0.1, 0.25, 0.5, 1.0)
 
 
-def _bucket_observe(counts: List[int], seconds: float) -> None:
-    """Increment the TTFT_BUCKETS histogram slot covering ``seconds``
-    (last slot is the +Inf tail)."""
-    for i, le in enumerate(TTFT_BUCKETS):
+def _bucket_observe(counts: List[int], seconds: float,
+                    bounds=TTFT_BUCKETS, n: int = 1) -> None:
+    """Add ``n`` observations of ``seconds`` to the ``bounds``
+    histogram slot covering it (last slot is the +Inf tail)."""
+    for i, le in enumerate(bounds):
         if seconds <= le:
-            counts[i] += 1
+            counts[i] += n
             return
-    counts[-1] += 1
+    counts[-1] += n
 
 
 def _histogram_samples(family: MetricFamily, name: str, labels: Dict[str, str],
-                       counts: List[int], total: float) -> None:
+                       counts: List[int], total: float,
+                       bounds=TTFT_BUCKETS) -> None:
     """Append one Prometheus histogram series (cumulative buckets +
-    sum + count) over TTFT_BUCKETS to ``family``."""
+    sum + count) over ``bounds`` to ``family``."""
     cum = 0
-    for le, count in zip(TTFT_BUCKETS, counts):
+    for le, count in zip(bounds, counts):
         cum += count
         family.samples.append(Sample(
             f"{name}_bucket", {**labels, "le": _format_value(le)}, cum))
@@ -182,6 +210,21 @@ class EngineConfig:
     # reservation would otherwise fail).  Output is bit-exact either
     # way; False buys back nothing but is the bench's control arm.
     prefix_cache: bool = True
+    # stall-free mixed batching: when prefill and decode work coexist,
+    # fuse ONE bounded prefill chunk into the decode dispatch instead
+    # of stalling every decode lane behind the prompt (the either/or
+    # Orca discipline's tail-latency cost).  Streams are bit-exact
+    # either way; False is the bench's control arm and restores strict
+    # prefill priority.
+    mixed: bool = True
+    # per-step cap on the prefill tokens fused into a mixed dispatch —
+    # the bound on the extra latency ANY decode lane (a Guarantee
+    # tenant's included) pays per admission ride-along.  A plan chunk
+    # wider than the budget is sliced to its leading largest-power-of-
+    # two piece <= budget (an already-warmed bucket width, so slicing
+    # never compiles a new shape).  None = prefill_chunk (whole chunks
+    # fuse, nothing is sliced).
+    mixed_prefill_budget: Optional[int] = None
 
 
 @dataclass
@@ -222,6 +265,10 @@ class _Pending:
     first_key: Optional[np.ndarray] = None
     step_keys: Optional[np.ndarray] = None
     emitted: List[int] = field(default_factory=list)
+    # a RESUMED entry's last pre-preemption emission time: the gap to
+    # the continuation's first token is a real inter-token stall and
+    # must land in the TBT histogram (the metric exists for that tail)
+    last_token_at: Optional[float] = None
 
 
 @dataclass
@@ -250,6 +297,7 @@ class _Slot:
         "idx", "state", "rid", "blocks", "table", "length", "generated",
         "prompt", "plan", "max_new", "temperature", "first_key",
         "step_keys", "result", "tenant", "emitted_prefix",
+        "last_token_at",
     )
 
     def __init__(self, idx: int, table_width: int) -> None:
@@ -275,6 +323,9 @@ class _Slot:
         # tokens emitted in earlier incarnations of a preempted request;
         # prepended to slot.generated at retirement
         self.emitted_prefix: List[int] = []
+        # wall time the slot's newest token became host-visible — the
+        # inter-token-latency histogram's reference point
+        self.last_token_at: Optional[float] = None
 
 
 class ServingEngine:
@@ -302,6 +353,10 @@ class ServingEngine:
             raise ValueError(f"prefill_chunk must be >= 1, got {ec.prefill_chunk}")
         if ec.decode_span < 1:
             raise ValueError(f"decode_span must be >= 1, got {ec.decode_span}")
+        if ec.mixed_prefill_budget is not None and ec.mixed_prefill_budget < 1:
+            raise ValueError(
+                f"mixed_prefill_budget must be >= 1 or None, got "
+                f"{ec.mixed_prefill_budget}")
         # fail fast on a bad filter set, like the dense sampling entries
         _filter_logits(jnp.zeros((1, 2)), ec.top_k, ec.top_p)
         self.params = params
@@ -318,6 +373,16 @@ class ServingEngine:
         self._table_width = -(-ec.max_request_len // ec.block_size)
         self._slots = [_Slot(i, self._table_width)
                        for i in range(ec.num_slots)]
+        # mixed-batching scheduler state: the effective fused-chunk
+        # budget, the prefill round-robin pointer (a many-chunk prompt
+        # must not monopolize prefill ticks over later admissions), and
+        # the one in-flight dispatch whose host-side effects are still
+        # pending (read when consumed — see _consume_inflight)
+        self._mixed_budget = (ec.mixed_prefill_budget
+                              if ec.mixed_prefill_budget is not None
+                              else ec.prefill_chunk)
+        self._prefill_rr = 0
+        self._inflight = None
         # admission queue: the QoS fair queue over _Pending entries
         # (plan + block count computed once at submit; _admit re-plans
         # only on a prefix-cache hit).  The default registry holds one
@@ -325,9 +390,15 @@ class ServingEngine:
         self.tenants = tenants or TenantRegistry.default()
         self._queue = FairQueue(self.tenants)
         self._results: Dict[str, RequestResult] = {}
-        # counters (the bench's and the metrics endpoint's raw material)
+        # counters (the bench's and the metrics endpoint's raw material):
+        # prefill_chunks / decode_steps count WORK UNITS (chunks
+        # processed, spans run — standalone or fused); mixed_steps
+        # counts fused dispatches, so standalone dispatch counts are
+        # prefill_chunks - mixed_steps and decode_steps - mixed_steps
+        # (a mixed dispatch carries exactly one of each).
         self.decode_steps = 0
         self.prefill_chunks = 0
+        self.mixed_steps = 0
         self.tokens_generated = 0
         self.peak_blocks_in_use = 0
         self.requests_admitted = 0
@@ -343,6 +414,11 @@ class ServingEngine:
         self.tenant_tokens: Dict[str, int] = {}
         self._ttft_class: Dict[str, list] = {
             cls: [[0] * (len(TTFT_BUCKETS) + 1), 0.0]
+            for cls in (QOS_GUARANTEE, QOS_OPPORTUNISTIC)}
+        # inter-token latency (time-between-tokens) histogram per QoS
+        # class — the tail metric mixed batching exists to flatten
+        self._tbt_class: Dict[str, list] = {
+            cls: [[0] * (len(TBT_BUCKETS) + 1), 0.0]
             for cls in (QOS_GUARANTEE, QOS_OPPORTUNISTIC)}
 
         cfg = config
@@ -381,29 +457,31 @@ class ServingEngine:
 
         def decode(w, pk, pv, tables, lengths, active, tokens, temps,
                    keys, budgets):
-            # ONE dispatch advances every lane up to `span` tokens: the
-            # scan body is EXACTLY the single step, so the emitted math
-            # is span-invariant; a lane whose request finishes mid-span
-            # (budget spent, or EOS sampled) deactivates itself — its
-            # remaining iterations write to the scratch block and its
-            # surplus emissions are ignored host-side.
-            def body(carry, i):
-                pk, pv, lengths, toks, alive = carry
-                logits, pk, pv = paged_decode_step(
-                    w, cfg, pk, pv, tables, lengths, alive, toks)
-                nxt = pick_rows(logits, temps, keys[:, i])
-                lengths = lengths + alive.astype(jnp.int32)
-                cont = alive & (i + 1 < budgets)
-                if eos is not None:
-                    cont = cont & (nxt != eos)
-                return (pk, pv, lengths, nxt, cont), nxt
-
-            carry = (pk, pv, lengths, tokens, active)
-            (pk, pv, _, _, _), emitted = jax.lax.scan(
-                body, carry, jnp.arange(span))
-            return emitted, pk, pv  # emitted [span, S]
+            # ONE dispatch advances every lane up to `span` tokens —
+            # the scan body is EXACTLY the single step (paged.py's
+            # paged_decode_span, shared verbatim with the mixed step),
+            # so the emitted math is span-invariant.
+            return paged_decode_span(
+                w, cfg, pick_rows, span, eos, pk, pv, tables, lengths,
+                active, tokens, temps, keys, budgets)
 
         self._decode_step = jax.jit(decode, donate_argnums=(1, 2))
+
+        def mixed(w, pk, pv, p_table, p_start, p_tokens, p_last_row,
+                  p_temp, p_key, d_tables, d_lengths, d_active,
+                  d_tokens, d_temps, d_keys, d_budgets):
+            # the stall-free fused dispatch: one bounded prefill chunk
+            # + the full decode span, ONE program — composed from the
+            # exact prefill/decode entry points above, so both sides'
+            # math (and therefore the emitted streams) are unchanged.
+            # Compiles one shape per prefill bucket width (warmed).
+            return paged_mixed_step(
+                w, cfg, pick_rows, span, eos, pk, pv, p_table, p_start,
+                p_tokens, p_last_row, p_temp, p_key, d_tables,
+                d_lengths, d_active, d_tokens, d_temps, d_keys,
+                d_budgets)
+
+        self._mixed_step = jax.jit(mixed, donate_argnums=(1, 2))
         # the copy-on-write primitive: one block, all layers, K and V —
         # a single static shape, so the cache adds exactly ONE compile.
         # Wrapped per-engine (like prefill/decode above): jitting the
@@ -476,23 +554,47 @@ class ServingEngine:
         return result
 
     def step(self) -> bool:
-        """One scheduling iteration: admit what fits, then run one
-        prefill chunk or one batched decode span.  Prefill has priority
-        (the Orca discipline): an empty slot earns nothing until its
-        prompt is cached, so filling slots first maximizes the width of
-        every subsequent decode step — and it is what bounds TTFT.
-        Decode lanes are static-shaped, so a fuller pool is pure win.
+        """One scheduling iteration: admit what fits, consume the
+        previous dispatch's results, then dispatch the next step.
+
+        Scheduling discipline: when prefill and decode work coexist
+        (and ``mixed`` is on, the default) ONE fused dispatch advances
+        every decode lane by its span AND consumes one budget-bounded
+        prefill chunk for one filling slot — decode lanes never wait
+        behind a prompt, and a filling slot still earns its chunk every
+        step.  With ``mixed`` off, prefill has strict priority (the
+        Orca either/or discipline — TTFT-optimal, but every prompt
+        chunk stalls every decode lane for its full duration).  Either
+        way, filling slots rotate round-robin so a many-chunk prompt
+        cannot monopolize prefill ticks over later admissions.
+
+        Pipelining: admission (pure host work — queue, allocator,
+        trie) runs BEFORE the previous dispatch's results are read, so
+        on an unguarded engine it overlaps device execution; the
+        emitted tokens are then consumed and the next step dispatched.
         Returns False when the engine is fully idle."""
         self._admit()
+        consumed = self._consume_inflight()
         prefill = [s for s in self._slots if s.state == "prefill"]
         decode = [s for s in self._slots if s.state == "decode"]
+        if prefill and decode and self.engine_config.mixed:
+            slot = self._next_prefill_slot(prefill)
+            chunk = self._sliced_chunk(slot)
+            if chunk[1] <= self._mixed_budget:
+                self._run_mixed_step(decode, slot, chunk)
+            else:
+                # an unsliceable pad-forward tail over the budget (its
+                # logits row sits inside the chunk): the one shape that
+                # still stalls decode, for a single bounded dispatch
+                self._run_prefill_chunk(slot, chunk)
+            return True
         if prefill:
-            self._run_prefill_chunk(prefill[0])
+            self._run_prefill_chunk(self._next_prefill_slot(prefill))
             return True
         if decode:
             self._run_decode_step(decode)
             return True
-        return False
+        return consumed
 
     def run(self) -> Dict[str, RequestResult]:
         """Drain the queue and every in-flight slot; returns results by
@@ -507,7 +609,8 @@ class ServingEngine:
 
     @property
     def idle(self) -> bool:
-        return not self._queue and all(s.state == "free" for s in self._slots)
+        return (not self._queue and self._inflight is None
+                and all(s.state == "free" for s in self._slots))
 
     def result(self, rid: str) -> RequestResult:
         return self._results[rid]
@@ -525,7 +628,10 @@ class ServingEngine:
 
     def warmup(self) -> None:
         """Compile every step the engine can ever dispatch: the decode
-        step and one prefill chunk per bucketed width.  After this, a
+        step, one prefill chunk per bucketed width, and (mixed
+        batching on) one MIXED shape per bucketed width — a sliced
+        fused chunk is always a power-of-two piece at or under the
+        budget, so the same bucket set covers it.  After this, a
         workload of any shape runs with ZERO recompilation
         (compile_counts stays fixed — test- and bench-asserted)."""
         ec = self.engine_config
@@ -540,6 +646,7 @@ class ServingEngine:
         widths = {min(w, ec.max_request_len) for w in widths}
         s = ec.num_slots
         one = jnp.zeros((1,), jnp.int32)
+        zeros_s = jnp.zeros((s,), jnp.int32)
         for width in sorted(widths):
             # the pool rides through every warmup call (its buffers are
             # donated); the only writes land in the scratch block
@@ -551,7 +658,23 @@ class ServingEngine:
                 jnp.zeros((1,), jnp.float32),
                 jnp.zeros((1, 2), jnp.uint32))
             self.pool = replace(self.pool, k=pk, v=pv)
-        zeros_s = jnp.zeros((s,), jnp.int32)
+            # mixed shapes only for widths that can actually ride
+            # fused: step() routes any chunk wider than the budget to
+            # the standalone path, so warming those would burn the most
+            # expensive compiles on unreachable shapes
+            if ec.mixed and width <= self._mixed_budget:
+                _, _, pk, pv = self._mixed_step(
+                    self.params, self.pool.k, self.pool.v,
+                    jnp.zeros((1, self._table_width), jnp.int32), one,
+                    jnp.zeros((1, width), jnp.int32), one,
+                    jnp.zeros((1,), jnp.float32),
+                    jnp.zeros((1, 2), jnp.uint32),
+                    jnp.zeros((s, self._table_width), jnp.int32),
+                    zeros_s, jnp.zeros((s,), bool), zeros_s,
+                    jnp.zeros((s,), jnp.float32),
+                    jnp.zeros((s, ec.decode_span, 2), jnp.uint32),
+                    zeros_s)
+                self.pool = replace(self.pool, k=pk, v=pv)
         _, pk, pv = self._decode_step(
             self.params, self.pool.k, self.pool.v,
             jnp.zeros((s, self._table_width), jnp.int32),
@@ -572,6 +695,7 @@ class ServingEngine:
         return {
             "decode": self._decode_step._cache_size(),
             "prefill": self._prefill_step._cache_size(),
+            "mixed": self._mixed_step._cache_size(),
             "copy": self._copy_step._cache_size(),
         }
 
@@ -602,9 +726,14 @@ class ServingEngine:
         tokens.add({}, self.tokens_generated)
         dispatches = MetricFamily(
             "kubeshare_serving_dispatches_total",
-            "Device dispatches by kind.", "counter")
-        dispatches.add({"kind": "prefill_chunk"}, self.prefill_chunks)
-        dispatches.add({"kind": "decode_span"}, self.decode_steps)
+            "Device dispatches by kind (mixed = one fused prefill "
+            "chunk + decode span; the standalone kinds exclude fused "
+            "work).", "counter")
+        dispatches.add({"kind": "prefill_chunk"},
+                       self.prefill_chunks - self.mixed_steps)
+        dispatches.add({"kind": "decode_span"},
+                       self.decode_steps - self.mixed_steps)
+        dispatches.add({"kind": "mixed"}, self.mixed_steps)
         dispatches.add({"kind": "cow_copy"}, self.cow_copies)
         prefix = MetricFamily(
             "kubeshare_serving_prefix_cache_requests_total",
@@ -658,9 +787,19 @@ class ServingEngine:
             _histogram_samples(
                 cls_ttft, "kubeshare_serving_ttft_by_class_seconds",
                 {"qos": cls}, counts, total)
+        tbt = MetricFamily(
+            "kubeshare_serving_tbt_seconds",
+            "Inter-token latency by QoS class: wall time between "
+            "consecutive host-visible tokens of one request (a span's "
+            "burst is attributed evenly across its tokens) — the tail "
+            "the mixed scheduler bounds.", "histogram")
+        for cls, (counts, total) in sorted(self._tbt_class.items()):
+            _histogram_samples(
+                tbt, "kubeshare_serving_tbt_seconds",
+                {"qos": cls}, counts, total, TBT_BUCKETS)
         return [req, blocks, tokens, dispatches, prefix, hit_tokens,
                 evicted, ttft, t_depth, t_blocks, t_tokens, preempt,
-                cls_ttft]
+                cls_ttft, tbt]
 
     def serve_metrics(self, port: int = 0) -> MetricServer:
         """Start the textfile HTTP scrape endpoint (``/metrics`` and
@@ -680,6 +819,16 @@ class ServingEngine:
         cls[1] += seconds
         _bucket_observe(self._ttft_counts, seconds)
         _bucket_observe(cls[0], seconds)
+
+    def _observe_tbt(self, per_token: float, count: int,
+                     tenant: str) -> None:
+        """Record ``count`` inter-token gaps of ``per_token`` seconds
+        each (a span's tokens become host-visible in one burst; the
+        burst's wall gap is attributed evenly)."""
+        cls = self._tbt_class[self.tenants.get(tenant).qos_class]
+        cls[1] += per_token * count
+        _bucket_observe(cls[0], per_token, TBT_BUCKETS, count)
+
     def _match_prefix(self, pending: _Pending) -> Tuple[int, List[int], Optional[int], List[Tuple[int, int, int]], int]:
         """Admission-time prefix lookup for one queued request: returns
         (start, shared_blocks, cow_src, plan, fresh_needed).  ``start``
@@ -743,6 +892,11 @@ class ServingEngine:
                         free = [s for s in self._slots
                                 if s.state == "free"]
                         progressed = True
+                        if not free:
+                            # consuming the in-flight span made progress
+                            # but freed no slot; re-walk before actually
+                            # preempting anyone
+                            break
                     else:
                         return
                 outcome = self._try_admit(self._queue.peek(tenant), spec,
@@ -858,6 +1012,7 @@ class ServingEngine:
         self.requests_admitted += 1
         slot.generated = []
         slot.emitted_prefix = list(pending.emitted)
+        slot.last_token_at = pending.last_token_at
         slot.prompt = pending.prompt
         slot.plan = list(plan)
         slot.max_new = pending.max_new
@@ -895,6 +1050,14 @@ class ServingEngine:
         highest slot index breaks ties deterministically.  Prefill-state
         slots are never preempted — their prompt is mid-write and worth
         nothing to the cache yet."""
+        # fresh state first: an unconsumed in-flight span may have
+        # already retired slots or advanced the would-be victim —
+        # preempting on stale state would build a wrong resume prompt,
+        # and consuming may free what admission needed without any
+        # preemption at all.  When it did something, report progress
+        # and let the admission loop retry before sacrificing anyone.
+        if self._consume_inflight():
+            return True
         victims = [
             s for s in self._slots
             if s.state == "decode"
@@ -954,72 +1117,90 @@ class ServingEngine:
             max_new=remaining, temperature=slot.temperature,
             plan=plan, needed=needed, first_key=first_key,
             step_keys=step_keys,
-            emitted=slot.emitted_prefix + slot.generated))
+            emitted=slot.emitted_prefix + slot.generated,
+            last_token_at=slot.last_token_at))
         self.preemptions[slot.tenant] = \
             self.preemptions.get(slot.tenant, 0) + 1
         slot._clear()
         slot.state = "free"
 
     def _dispatch(self, fn, *args):
-        """Every device burst charges through the guard — the same
-        token-gated shape as the run-to-completion serving path."""
-        if self.guard is not None:
-            self.guard.acquire()
+        """Every device burst charges through the guard when one is
+        attached — acquire, SYNC, charge measured wall time (the same
+        token-gated shape as the run-to-completion serving path).  The
+        sync is GUARD-ONLY: an unguarded engine leaves the dispatch
+        asynchronous, so host-side work (admission, the caller's
+        arrival loop) overlaps device execution, and emitted tokens
+        are read one step later in :meth:`_consume_inflight`."""
+        if self.guard is None:
+            return fn(*args)
+        self.guard.acquire()
         start = time.monotonic()
         try:
             out = jax.block_until_ready(fn(*args))
         finally:
-            if self.guard is not None:
-                self.guard.charge((time.monotonic() - start) * 1e3)
+            self.guard.charge((time.monotonic() - start) * 1e3)
         return out
 
-    def _run_prefill_chunk(self, slot: _Slot) -> None:
-        # ONE lane per prefill dispatch: chunks are already MXU-shaped
-        # [width, d] work, so batching lanes buys nothing compute-wise —
-        # and a static multi-lane shape would bill every dispatch for
-        # its padded lanes (measured ~2x on the serving bench when most
-        # dispatches carry one mid-flight admission).  The first-token
-        # pick rides fused in the same dispatch.
+    def _next_prefill_slot(self, prefill: List[_Slot]) -> _Slot:
+        """Round-robin over filling slots: the prefill slot at or
+        after the rotating pointer goes next, so a many-chunk prompt
+        shares prefill ticks with later admissions instead of
+        monopolizing them (the old ``prefill[0]`` head-of-line bug)."""
+        chosen = min(prefill, key=lambda s:
+                     (s.idx - self._prefill_rr) % len(self._slots))
+        self._prefill_rr = (chosen.idx + 1) % len(self._slots)
+        return chosen
+
+    def _sliced_chunk(self, slot: _Slot) -> Tuple[int, int, int]:
+        """Pop the slot's next prefill chunk for a mixed dispatch,
+        sliced to the fused budget: a wider chunk yields its leading
+        largest-power-of-two piece <= budget and the remainder
+        re-enters the plan head as POWER-OF-TWO chunks (binary
+        decomposition, widest first).  Every piece — dispatched fused
+        OR standalone, should the decode pool drain mid-slice — is an
+        already-warmed bucket width, so slicing never compiles a new
+        shape (review regression: a raw ``width - piece`` remainder is
+        not a bucket width).  A pad-forward chunk (its logits row
+        inside the chunk, not at its end) cannot be split around its
+        logits row and is returned whole."""
         start, width, last_row = slot.plan.pop(0)
+        budget = self._mixed_budget
+        if width <= budget or last_row != width - 1:
+            return (start, width, last_row)
+        piece = 1 << (budget.bit_length() - 1)
+        rest, offset, rem = [], start + piece, width - piece
+        while rem:
+            w = 1 << (rem.bit_length() - 1)
+            rest.append((offset, w, w - 1))
+            offset += w
+            rem -= w
+        slot.plan[:0] = rest
+        return (start, piece, piece - 1)
+
+    def _prefill_lane(self, slot: _Slot, chunk: Tuple[int, int, int]):
+        """Device arguments for one slot's prefill chunk — shared by
+        the standalone and the mixed dispatch, so both run the exact
+        same lane."""
+        start, width, last_row = chunk
         final = not slot.plan
         segment = slot.prompt[start: start + width]
         if segment.size < width:  # short-prompt pad tail (dead rows)
             segment = np.pad(segment, (0, width - segment.size))
-        picked, pk, pv = self._dispatch(
-            self._prefill_step, self.params, self.pool.k, self.pool.v,
-            jnp.asarray(slot.table[None]), jnp.asarray([start], np.int32),
-            jnp.ones((1,), bool), jnp.asarray(segment[None]),
-            jnp.asarray([last_row], np.int32),
-            # the pick is consumed only on the prompt's final chunk
-            jnp.asarray([slot.temperature if final else 0.0], np.float32),
-            jnp.asarray((slot.first_key if final else
-                         np.zeros(2, np.uint32))[None]))
-        self.pool = replace(self.pool, k=pk, v=pv)
-        self.prefill_chunks += 1
-        # fair-share service: the prefill width actually dispatched (a
-        # prefix-cache hit charges only its uncached suffix — tokend's
-        # charge-measured-work principle)
-        self._queue.charge(slot.tenant, width)
-        if not final:
-            return
-        # prompt fully cached: the fused pick at the final chunk's
-        # last-real-row logits IS the first token; join the decode pool
-        first = int(np.asarray(picked)[0])
-        slot.length = slot.prompt.size
-        slot.generated = [first]
-        if slot.result.first_token_at is None:
-            # a RESUMED slot keeps its original first-token time — TTFT
-            # is a property of the request, not of its incarnations
-            slot.result.first_token_at = time.monotonic()
-            self._observe_ttft(slot.result.ttft, slot.tenant)
-        self.tokens_generated += 1
-        self.tenant_tokens[slot.tenant] = \
-            self.tenant_tokens.get(slot.tenant, 0) + 1
-        self._queue.charge(slot.tenant, 1)
-        slot.state = "decode"
-        self._maybe_retire(slot, first)
+        return (final,
+                jnp.asarray(slot.table[None]),
+                jnp.asarray([start], np.int32),
+                jnp.asarray(segment[None]),
+                jnp.asarray([last_row], np.int32),
+                # the pick is consumed only on the prompt's final chunk
+                jnp.asarray([slot.temperature if final else 0.0],
+                            np.float32),
+                jnp.asarray((slot.first_key if final else
+                             np.zeros(2, np.uint32))[None]))
 
-    def _run_decode_step(self, decode_slots: List[_Slot]) -> None:
+    def _decode_lanes(self, decode_slots: List[_Slot]):
+        """Device arguments for a decode span over the slot pool —
+        shared by the standalone and the mixed dispatch."""
         ec = self.engine_config
         s, span = ec.num_slots, ec.decode_span
         tables = np.zeros((s, self._table_width), np.int32)
@@ -1043,6 +1224,39 @@ class ServingEngine:
                 offset = len(slot.generated) - 1
                 window = slot.step_keys[offset: offset + span]
                 keys[i, : len(window)] = window
+        return tables, lengths, active, tokens, temps, keys, budgets
+
+    def _run_prefill_chunk(self, slot: _Slot,
+                           chunk: Optional[Tuple[int, int, int]] = None
+                           ) -> None:
+        # ONE lane per prefill dispatch: chunks are already MXU-shaped
+        # [width, d] work, so batching lanes buys nothing compute-wise —
+        # and a static multi-lane shape would bill every dispatch for
+        # its padded lanes (measured ~2x on the serving bench when most
+        # dispatches carry one mid-flight admission).  The first-token
+        # pick rides fused in the same dispatch.
+        if chunk is None:
+            chunk = slot.plan.pop(0)
+        final, table, start, segment, last_row, temp, key = \
+            self._prefill_lane(slot, chunk)
+        picked, pk, pv = self._dispatch(
+            self._prefill_step, self.params, self.pool.k, self.pool.v,
+            table, start, jnp.ones((1,), bool), segment, last_row,
+            temp, key)
+        self.pool = replace(self.pool, k=pk, v=pv)
+        self.prefill_chunks += 1
+        # fair-share service: the prefill width actually dispatched (a
+        # prefix-cache hit charges only its uncached suffix — tokend's
+        # charge-measured-work principle)
+        self._queue.charge(slot.tenant, chunk[1])
+        if final:
+            # the fused pick at the final chunk's last-real-row logits
+            # IS the first token; read when consumed (one step later)
+            self._inflight = (None, [], None, (slot, picked))
+
+    def _run_decode_step(self, decode_slots: List[_Slot]) -> None:
+        tables, lengths, active, tokens, temps, keys, budgets = \
+            self._decode_lanes(decode_slots)
         emitted, pk, pv = self._dispatch(
             self._decode_step, self.params, self.pool.k, self.pool.v,
             jnp.asarray(tables), jnp.asarray(lengths), jnp.asarray(active),
@@ -1050,7 +1264,79 @@ class ServingEngine:
             jnp.asarray(budgets))
         self.pool = replace(self.pool, k=pk, v=pv)
         self.decode_steps += 1
-        emitted = np.asarray(emitted)  # [span, S]
+        self._inflight = (emitted, list(decode_slots), budgets, None)
+
+    def _run_mixed_step(self, decode_slots: List[_Slot], p_slot: _Slot,
+                        chunk: Tuple[int, int, int]) -> None:
+        """The stall-free fused dispatch: every decode lane advances
+        its span AND ``p_slot`` consumes one budget-bounded prefill
+        chunk, in ONE device program (``paged.paged_mixed_step``)."""
+        final, table, start, segment, last_row, temp, key = \
+            self._prefill_lane(p_slot, chunk)
+        tables, lengths, active, tokens, temps, keys, budgets = \
+            self._decode_lanes(decode_slots)
+        picked, emitted, pk, pv = self._dispatch(
+            self._mixed_step, self.params, self.pool.k, self.pool.v,
+            table, start, segment, last_row, temp, key,
+            jnp.asarray(tables), jnp.asarray(lengths), jnp.asarray(active),
+            jnp.asarray(tokens), jnp.asarray(temps), jnp.asarray(keys),
+            jnp.asarray(budgets))
+        self.pool = replace(self.pool, k=pk, v=pv)
+        self.prefill_chunks += 1
+        self.decode_steps += 1
+        self.mixed_steps += 1
+        self._queue.charge(p_slot.tenant, chunk[1])
+        self._inflight = (emitted, list(decode_slots), budgets,
+                          (p_slot, picked) if final else None)
+
+    def _consume_inflight(self) -> bool:
+        """Apply the previous dispatch's host-side effects: read its
+        emitted tokens (the only device sync in the unguarded hot
+        loop) and run first-token/acceptance/retirement bookkeeping.
+        Runs before every new dispatch and before any scheduling
+        decision that needs fresh slot state (preemption).  Returns
+        True when there was something to consume."""
+        if self._inflight is None:
+            return False
+        emitted, decode_slots, budgets, prefill_part = self._inflight
+        self._inflight = None
+        if prefill_part is not None:
+            slot, picked = prefill_part
+            self._finish_prefill(slot, int(np.asarray(picked)[0]))
+        if decode_slots:
+            self._accept_decode(decode_slots, np.asarray(emitted), budgets)
+        return True
+
+    def _finish_prefill(self, slot: _Slot, first: int) -> None:
+        # prompt fully cached: join the decode pool with the fused
+        # first-token pick as the stream's head
+        slot.length = slot.prompt.size
+        slot.generated = [first]
+        now = time.monotonic()
+        if slot.result.first_token_at is None:
+            # a RESUMED slot keeps its original first-token time — TTFT
+            # is a property of the request, not of its incarnations
+            slot.result.first_token_at = now
+            self._observe_ttft(slot.result.ttft, slot.tenant)
+        elif slot.last_token_at is not None:
+            # resumed after preemption: the stretch from the victim's
+            # last pre-preemption token to this one (queue wait +
+            # re-prefill) is a REAL inter-token gap — the exact stall
+            # the TBT histogram exists to expose
+            self._observe_tbt(now - slot.last_token_at, 1, slot.tenant)
+        slot.last_token_at = now
+        self.tokens_generated += 1
+        self.tenant_tokens[slot.tenant] = \
+            self.tenant_tokens.get(slot.tenant, 0) + 1
+        self._queue.charge(slot.tenant, 1)
+        slot.state = "decode"
+        self._maybe_retire(slot, first)
+
+    def _accept_decode(self, decode_slots: List[_Slot],
+                       emitted: np.ndarray, budgets: np.ndarray) -> None:
+        ec = self.engine_config
+        span = ec.decode_span
+        now = time.monotonic()
         for slot in decode_slots:
             i = slot.idx
             # mirror the device's lane-deactivation rule exactly: accept
@@ -1070,6 +1356,10 @@ class ServingEngine:
                 self.tenant_tokens[slot.tenant] = \
                     self.tenant_tokens.get(slot.tenant, 0) + accepted
                 self._queue.charge(slot.tenant, accepted)
+                gap = now - (slot.last_token_at
+                             if slot.last_token_at is not None else now)
+                self._observe_tbt(gap / accepted, accepted, slot.tenant)
+                slot.last_token_at = now
             self._maybe_retire(slot, slot.generated[-1])
 
     def _maybe_retire(self, slot: _Slot, token: int) -> None:

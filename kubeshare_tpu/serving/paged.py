@@ -14,7 +14,20 @@ only the cache plumbing:
 - :func:`paged_decode_step`: one token for EVERY active slot at once —
   per-slot positions, scatter-write each slot's K/V into its current
   block, gather each slot's block list into a [S, h_kv, V, d] view, and
-  attend under per-row causal bands.
+  attend under per-row causal bands;
+- :func:`paged_decode_span`: the multi-token decode dispatch — a
+  ``lax.scan`` of step-identical :func:`paged_decode_step` iterations
+  with the engine's token-pick policy between steps (lanes
+  self-deactivate on budget/EOS);
+- :func:`paged_mixed_step`: the stall-free mixed dispatch — ONE program
+  that consumes one bounded prefill chunk for one filling slot AND runs
+  a full decode span for every active lane.  It is a pure composition
+  of the two entry points above (prefill first, then the span), so the
+  per-lane math is op-for-op the split dispatches' math: the prefill
+  lane's blocks are disjoint from every decode lane's writable blocks
+  (shared prefix blocks are read-only to both — divergence is
+  copied-on-write before any append), so fusing the phases cannot
+  change either side's values, only the number of device round-trips.
 
 Equivalence with the dense cache is test-locked (tests/test_serving.py):
 greedy and sampled streams from the paged pool match ``init_kv_cache``
@@ -247,3 +260,104 @@ def paged_decode_step(
     x = _rms_norm(x, params["final_norm"]["scale"])
     logits = (x @ params["lm_head"].astype(dtype)).astype(jnp.float32)
     return logits[:, 0], jnp.stack(new_k), jnp.stack(new_v)
+
+
+def paged_decode_span(
+    params,
+    config: TransformerConfig,
+    pick_fn,
+    span: int,
+    eos,
+    pool_k,
+    pool_v,
+    tables,
+    lengths,
+    active,
+    tokens,
+    temps,
+    keys,
+    budgets,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Advance every active lane up to ``span`` tokens in ONE dispatch.
+
+    The scan body is EXACTLY :func:`paged_decode_step` plus the
+    engine's ``pick_fn(logits, temps, keys[:, i])`` token policy, so
+    the emitted math is span-invariant; a lane whose request finishes
+    mid-span (budget spent, or EOS sampled) deactivates itself — its
+    remaining iterations write to the scratch block and its surplus
+    emissions are ignored host-side.  Returns
+    (emitted [span, S], pool_k, pool_v).  ``pick_fn``/``span``/``eos``
+    are trace-time constants (the engine closes over them under jit).
+    """
+
+    def body(carry, i):
+        pk, pv, lens, toks, alive = carry
+        logits, pk, pv = paged_decode_step(
+            params, config, pk, pv, tables, lens, alive, toks)
+        nxt = pick_fn(logits, temps, keys[:, i])
+        lens = lens + alive.astype(jnp.int32)
+        cont = alive & (i + 1 < budgets)
+        if eos is not None:
+            cont = cont & (nxt != eos)
+        return (pk, pv, lens, nxt, cont), nxt
+
+    carry = (pool_k, pool_v, lengths, tokens, active)
+    (pk, pv, _, _, _), emitted = jax.lax.scan(
+        body, carry, jnp.arange(span))
+    return emitted, pk, pv
+
+
+def paged_mixed_step(
+    params,
+    config: TransformerConfig,
+    pick_fn,
+    span: int,
+    eos,
+    pool_k,
+    pool_v,
+    p_table,
+    p_start,
+    p_tokens,
+    p_last_row,
+    p_temp,
+    p_key,
+    d_tables,
+    d_lengths,
+    d_active,
+    d_tokens,
+    d_temps,
+    d_keys,
+    d_budgets,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One fused mixed dispatch: a bounded prefill chunk for ONE
+    filling slot + a full decode span for every active decode lane.
+
+    This is the stall-free alternative to the engine's either/or step:
+    under strict prefill priority every in-flight decode lane stalls
+    for the full duration of every prompt chunk, so one long prompt
+    spikes inter-token latency for ALL tenants.  Fusing the phases
+    into one program keeps every decode lane advancing while the
+    prompt fills, and pays ONE dispatch where the split path pays two.
+
+    The composition is deliberately nothing but the two existing entry
+    points run back to back — :func:`paged_prefill_step` on the
+    prefill lane, then :func:`paged_decode_span` over the decode lanes
+    — so the per-row-position attention math is reused unchanged and
+    the emitted streams are bit-exact with the split dispatches:
+    the prefill lane writes only its own (fresh or CoW-private)
+    blocks, every decode lane writes only its own current block, and
+    the prefill-then-decode order inside the program matches the split
+    scheduler's dispatch order.  Returns
+    (p_picked [1], emitted [span, S], pool_k, pool_v); ``p_picked`` is
+    meaningful only when the chunk is the prompt's final one (the
+    fused first-token pick, same as the standalone prefill step).
+    """
+    p_logits, pk, pv = paged_prefill_step(
+        params, config, pool_k, pool_v, p_table, p_start,
+        jnp.ones_like(p_start, bool), p_tokens, p_last_row)
+    p_picked = pick_fn(p_logits, p_temp, p_key)
+    emitted, pk, pv = paged_decode_span(
+        params, config, pick_fn, span, eos, pk, pv,
+        d_tables, d_lengths, d_active, d_tokens, d_temps, d_keys,
+        d_budgets)
+    return p_picked, emitted, pk, pv

@@ -39,6 +39,19 @@ admission that cannot be funded retires an Opportunistic decode slot's
 blocks into the prefix index and re-queues it — the radix cache makes
 the preemption nearly free, because the victim later resumes from its
 first uncached token, bit-exactly).
+
+Interaction with MIXED BATCHING (``engine.py``): under the engine's
+stall-free mixed scheduling an admission's prefill chunks ride along
+fused with the decode dispatch instead of stalling it, so the latency
+a Guarantee tenant's decode lanes pay per admission — ANY tenant's
+admission, its own included — is bounded by
+``EngineConfig.mixed_prefill_budget`` tokens of prefill per step,
+rather than the full (unbounded) chunk sequence of whatever prompt the
+fair queue admits next.  Class semantics are unchanged: the fair queue
+still orders who is admitted, quotas still gate the blocks, preemption
+still runs cache-backed and resumes bit-exactly — mixed scheduling
+only changes how the admitted work shares device dispatches with the
+lanes already running.
 """
 
 from __future__ import annotations
