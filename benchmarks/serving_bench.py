@@ -82,7 +82,18 @@ Run:
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --multi-tenant --smoke
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --mixed
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --mixed --smoke
-    make serve-smoke serve-prefix-smoke serve-qos-smoke serve-mixed-smoke
+    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --tiered
+    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --tiered --smoke
+    make serve-smoke serve-prefix-smoke serve-qos-smoke serve-mixed-smoke \
+         serve-tier-smoke
+
+- ``--tiered`` switches to the KV-TIERING comparison: a many-distinct-
+  shared-prefixes trace whose prefix working set exceeds the device
+  pool's idle-cache capacity, replayed with the host-RAM tier on vs off
+  (ABA-bracketed) plus an HBM-sized-pool reference arm — the headline
+  is how much of the big pool's skipped-token rate the host tier
+  recovers on the small pool (hit-rate, not HBM, setting the ceiling),
+  with every stream hard-asserted identical across all arms.
 """
 
 from __future__ import annotations
@@ -255,6 +266,71 @@ def mixed_settings() -> dict:
     )
 
 
+def tiered_smoke_settings() -> dict:
+    """Seconds-fast KV-tiering path (CI, tests/test_serving.py): five
+    distinct 40-token shared prefixes (25 blocks of working set at
+    block_size 8) over a 32-block device pool that can keep only a few
+    of them cached at once — prefixes churn out of HBM between reuses,
+    which is exactly the traffic the host tier exists to absorb."""
+    return dict(
+        d_model=128, n_layers=1, n_heads=4, n_kv_heads=2, d_ff=256,
+        vocab_size=512, max_seq_len=96,
+        num_requests=18,
+        num_slots=3, block_size=8, num_blocks=33,     # 32 usable
+        hbm_num_blocks=61,                            # the HBM-sized arm
+        host_tier_bytes=400_000,                      # ~45 wire blocks
+        max_request_len=96, prefill_chunk=16,
+        num_prefixes=5, prefix_len=40, tail_lo=4, tail_hi=12,
+        new_lo=4, new_hi=12,
+        mean_interarrival_s=0.01, seed=0,
+    )
+
+
+def tiered_settings() -> dict:
+    """The KV-tiering capture configuration (acceptance shape): eight
+    distinct 128-token prefixes = 64 blocks of shared working set at
+    block_size 16, served from an 80-block device pool (~1/2 the
+    working set once live requests take their share) vs a 160-block
+    HBM-sized pool; the host tier budget covers the full working set,
+    so with tiering on the hit rate should track the big pool's."""
+    return dict(
+        d_model=256, n_layers=4, n_heads=8, n_kv_heads=2, d_ff=1024,
+        vocab_size=4096, max_seq_len=320,
+        num_requests=56,
+        num_slots=4, block_size=16, num_blocks=81,    # 80 usable
+        hbm_num_blocks=161,                           # 160 usable
+        host_tier_bytes=2_500_000,                    # ~75 wire blocks
+        max_request_len=224, prefill_chunk=64,
+        num_prefixes=8, prefix_len=128, tail_lo=8, tail_hi=24,
+        new_lo=16, new_hi=48,
+        mean_interarrival_s=0.02, seed=0,
+    )
+
+
+def build_tiered_workload(s: dict):
+    """Many-distinct-shared-prefixes trace: every request opens with
+    one of ``num_prefixes`` common ``prefix_len``-token prefixes
+    (chosen uniformly — reuses of one prefix are interleaved with the
+    others, so the small device pool churns between them) followed by
+    a private tail.  Returns (trace, total shared-prefix tokens)."""
+    rng = np.random.default_rng(s["seed"])
+    prefixes = [rng.integers(0, s["vocab_size"],
+                             s["prefix_len"]).astype(np.int32)
+                for _ in range(s["num_prefixes"])]
+    trace = []
+    t = 0.0
+    for i in range(s["num_requests"]):
+        t += float(rng.exponential(s["mean_interarrival_s"]))
+        prefix = prefixes[int(rng.integers(s["num_prefixes"]))]
+        tail = rng.integers(
+            0, s["vocab_size"],
+            int(rng.integers(s["tail_lo"], s["tail_hi"] + 1)))
+        prompt = np.concatenate([prefix, tail]).astype(np.int32)
+        max_new = int(rng.integers(s["new_lo"], s["new_hi"] + 1))
+        trace.append((f"req{i}", prompt, max_new, t))
+    return trace, s["num_requests"] * s["prefix_len"]
+
+
 def build_mixed_workload(s: dict):
     """Long-prompt/decode-mix trace: ``long_fraction`` of requests
     carry a multi-chunk prompt (and few output tokens — ingest-heavy
@@ -416,14 +492,19 @@ def _hist_quantile(buckets, q: float):
 
 def run_continuous(params, config, s: dict, trace,
                    prefix_cache: bool = True, registry=None,
-                   tenant_of=None, mixed: bool = True) -> dict:
+                   tenant_of=None, mixed: bool = True,
+                   host_tier_bytes=None, num_blocks=None) -> dict:
     from kubeshare_tpu.serving import EngineConfig, Request, ServingEngine
 
     engine = ServingEngine(params, config, EngineConfig(
         num_slots=s["num_slots"], block_size=s["block_size"],
-        num_blocks=s["num_blocks"], max_request_len=s["max_request_len"],
+        num_blocks=(num_blocks if num_blocks is not None
+                    else s["num_blocks"]),
+        max_request_len=s["max_request_len"],
         prefill_chunk=s["prefill_chunk"], prefix_cache=prefix_cache,
-        mixed=mixed, decode_span=s.get("decode_span", 4)),
+        mixed=mixed, decode_span=s.get("decode_span", 4),
+        host_tier_bytes=host_tier_bytes,
+        tier_policy=s.get("tier_policy", "lru")),
         tenants=registry)
     engine.warmup()
     compiles_before = engine.compile_counts()
@@ -497,8 +578,37 @@ def run_continuous(params, config, s: dict, trace,
         "cow_copies": int(metric[
             ("kubeshare_serving_dispatches_total",
              (("kind", "cow_copy"),))]),
-        "evicted_blocks": int(metric[
-            ("kubeshare_serving_prefix_evicted_blocks_total", ())]),
+        # the eviction family grew a `reason` label (tiering PR): sum
+        # for the total, keep the per-reason split alongside
+        "evicted_blocks": int(sum(
+            v for (name, _), v in metric.items()
+            if name == "kubeshare_serving_prefix_evicted_blocks_total")),
+        "evictions_by_reason": {
+            dict(labels)["reason"]: int(v)
+            for (name, labels), v in metric.items()
+            if name == "kubeshare_serving_prefix_evicted_blocks_total"},
+        "tier": {
+            "demoted": int(metric[("kubeshare_serving_tier_blocks_total",
+                                   (("event", "demoted"),))]),
+            "promoted": int(metric[("kubeshare_serving_tier_blocks_total",
+                                    (("event", "promoted"),))]),
+            "dropped": int(metric[("kubeshare_serving_tier_blocks_total",
+                                   (("event", "dropped"),))]),
+            "host_evicted": int(metric[
+                ("kubeshare_serving_tier_blocks_total",
+                 (("event", "host_evicted"),))]),
+            "hit_requests": int(metric[
+                ("kubeshare_serving_tier_requests_total",
+                 (("result", "hit"),))]),
+            "hit_tokens": int(metric[
+                ("kubeshare_serving_tier_hit_tokens_total", ())]),
+            "host_bytes_used": int(metric[
+                ("kubeshare_serving_tier_host_bytes",
+                 (("kind", "used"),))]),
+            "promotion_stall_s": float(metric[
+                ("kubeshare_serving_tier_promotion_stall_seconds_total",
+                 ())]),
+        },
         "preemptions": preemptions,
         "recompiles": recompiles,
         "requests": requests,
@@ -732,6 +842,98 @@ def run_mixed_bench(s: dict, aba: bool = True) -> dict:
     }
 
 
+def run_tiered_bench(s: dict, aba: bool = True) -> dict:
+    """KV tiering on vs off with the device pool sized BELOW the
+    shared-prefix working set, plus an HBM-sized reference pool:
+
+    - **tier_off_a / tier_off_b**: the small pool, no host tier — the
+      ABA bracket (first-trace-run host costs otherwise bias whichever
+      arm runs first; docs/perf.md methodology).  Prefixes churn out of
+      the pool between reuses and their prefill is paid again;
+    - **tiered**: the SAME small pool with a host-RAM tier budgeted to
+      hold the working set — evicted prefixes demote, reuses promote;
+    - **hbm_sized**: a device pool big enough to keep every prefix
+      cached — the skipped-token rate an HBM-sized cache achieves, the
+      ceiling the tier should recover.
+
+    Headline: the tiered arm's prefix-hit (skipped-token) rate
+    recovering most of the HBM-sized arm's, TTFT p50 vs tiering off —
+    with every stream hard-asserted identical across all arms and zero
+    recompiles after warmup.  ``aba=False`` drops the second bracketing
+    run (tests lock mechanics, not timing)."""
+    config, params = _bench_model(s)
+    trace, shared_tokens = build_tiered_workload(s)
+
+    off_a = run_continuous(params, config, s, trace)
+    tiered = run_continuous(params, config, s, trace,
+                            host_tier_bytes=s["host_tier_bytes"])
+    off_b = run_continuous(params, config, s, trace) if aba else off_a
+    hbm = run_continuous(params, config, s, trace,
+                         num_blocks=s["hbm_num_blocks"])
+    recompiles = (off_a.pop("recompiles") + tiered.pop("recompiles")
+                  + (off_b.pop("recompiles") if aba else 0)
+                  + hbm.pop("recompiles"))
+    if recompiles:
+        raise RuntimeError(
+            f"{recompiles} recompilations after warmup — a static-shape "
+            f"leak; the comparison (and a TPU serving pod) is invalid")
+    # tier correctness end to end: demote/promote may not change ONE
+    # token of any stream, at any pool size
+    arms = {"tier_off_a": off_a, "tiered": tiered, "hbm_sized": hbm}
+    if aba:
+        arms["tier_off_b"] = off_b
+    mismatched = [
+        (name, rid) for name, arm in arms.items() if name != "tiered"
+        for rid in tiered["requests"]
+        if tiered["requests"][rid]["tokens"]
+        != arm["requests"][rid]["tokens"]]
+    if mismatched:
+        raise RuntimeError(
+            f"streams diverged vs the tiered arm for {mismatched} — "
+            f"demote/promote is NOT bit-exact")
+    for arm in arms.values():
+        arm.pop("requests", None)
+    # off_b IS off_a when aba=False, so the plain mean covers both modes
+    off_hit = (off_a["prefix_hit_tokens"]
+               + off_b["prefix_hit_tokens"]) / 2
+    off_ttft = (off_a["ttft_s"]["p50"] + off_b["ttft_s"]["p50"]) / 2
+    off_tps = (off_a["tokens_per_s"] + off_b["tokens_per_s"]) / 2
+    # skipped-token rates against the whole shared-prefix token volume
+    # (first touch of each prefix is necessarily cold in every arm)
+    hit_rate_off = off_hit / max(1, shared_tokens)
+    hit_rate_tiered = tiered["prefix_hit_tokens"] / max(1, shared_tokens)
+    hit_rate_hbm = hbm["prefix_hit_tokens"] / max(1, shared_tokens)
+    recovery = ((hit_rate_tiered - hit_rate_off)
+                / max(1e-9, hit_rate_hbm - hit_rate_off))
+    return {
+        "suite": "serving-tier",
+        "metric": "prefix-hit (skipped-token) rate with a host tier "
+                  "under a device pool sized below the shared-prefix "
+                  "working set, vs tiering off (ABA-bracketed) and vs "
+                  "an HBM-sized pool (same many-prefix Poisson trace)",
+        "settings": {k: v for k, v in s.items()},
+        "shared_prefix_tokens": shared_tokens,
+        "tiered": tiered,
+        "tier_off_first": off_a,
+        "tier_off_last": off_b,
+        "tier_off": {"tokens_per_s": off_tps,
+                     "ttft_p50_s": off_ttft,
+                     "prefix_hit_tokens": off_hit},
+        "hbm_sized": hbm,
+        "hit_rate": {"tier_off": hit_rate_off,
+                     "tiered": hit_rate_tiered,
+                     "hbm_sized": hit_rate_hbm},
+        "hit_recovery_vs_hbm": recovery,
+        "ttft_p50_ratio": off_ttft
+        / max(1e-9, tiered["ttft_s"]["p50"]),
+        "tokens_per_s_ratio": tiered["tokens_per_s"]
+        / max(1e-9, off_tps),
+        "streams_bit_exact": True,
+        "recompiles_after_warmup": recompiles,
+        "platform": jax.default_backend(),
+    }
+
+
 def _tenant_stats(requests: dict, trace, tenant_of, tenant: str) -> dict:
     """Per-tenant aggregates over one run's raw request records:
     tokens/s over the tenant's active span (first arrival to last
@@ -853,9 +1055,16 @@ def main() -> None:
     parser.add_argument("--mixed", action="store_true",
                         help="stall-free mixed batching on/off on a "
                              "long-prompt/decode-mix trace")
+    parser.add_argument("--tiered", action="store_true",
+                        help="host-RAM KV tier on/off with the device "
+                             "pool sized below the shared-prefix "
+                             "working set, vs an HBM-sized pool")
     parser.add_argument("--json", help="write the result JSON here too")
     args = parser.parse_args()
-    if args.mixed:
+    if args.tiered:
+        result = run_tiered_bench(
+            tiered_smoke_settings() if args.smoke else tiered_settings())
+    elif args.mixed:
         result = run_mixed_bench(
             mixed_smoke_settings() if args.smoke else mixed_settings())
     elif args.multi_tenant:
@@ -872,6 +1081,22 @@ def main() -> None:
     if args.json:
         with open(args.json, "w") as f:
             f.write(text + "\n")
+    if args.tiered:
+        hr = result["hit_rate"]
+        tier = result["tiered"]["tier"]
+        print(f"\nkv tiering under a pool ~1/2 the prefix working set: "
+              f"skipped-token rate {hr['tiered']:.3f} vs "
+              f"{hr['tier_off']:.3f} off / {hr['hbm_sized']:.3f} "
+              f"HBM-sized ("
+              f"{100 * result['hit_recovery_vs_hbm']:.0f}% of the "
+              f"HBM-sized cache's advantage recovered, target >= 50%); "
+              f"TTFT p50 {result['ttft_p50_ratio']:.2f}x lower than "
+              f"off; tokens/s ratio {result['tokens_per_s_ratio']:.3f}; "
+              f"{tier['demoted']} demotions, {tier['promoted']} "
+              f"promotions, {tier['dropped']} drops, "
+              f"{1e3 * tier['promotion_stall_s']:.1f} ms promotion "
+              f"stall; streams bit-exact", file=sys.stderr)
+        return
     if args.mixed:
         on, off = result["mixed"], result["unmixed"]
         print(f"\nmixed batching: TBT p99 "

@@ -80,7 +80,13 @@ def main() -> None:
         # admission's prefill chunks ride the decode dispatch — capped
         # at 16 fused prefill tokens per step, the bound on the extra
         # latency any in-flight stream pays per admission
-        mixed=True, mixed_prefill_budget=16)
+        mixed=True, mixed_prefill_budget=16,
+        # KV cache tiering: prefixes evicted from the 32-block pool
+        # demote into a 1 MB host-RAM tier (~31 serialized blocks)
+        # instead of being destroyed, and promote back on a trie hit —
+        # the QoS-aware policy protects prod-charged host bytes from
+        # batch pressure
+        host_tier_bytes=1 << 20, tier_policy="qos")
     dense_bytes = (2 * config.n_layers * engine_config.num_slots
                    * config.kv_heads * config.max_seq_len
                    * config.head_dim * 4)
@@ -206,6 +212,16 @@ def main() -> None:
               f" standalone chunks, "
               f"{engine.decode_steps - engine.mixed_steps} standalone "
               f"spans")
+        print(f"kv tier ({engine_config.tier_policy} policy, "
+              f"{engine_config.host_tier_bytes >> 10} KiB host budget): "
+              f"{engine.tier_demoted_blocks} blocks demoted host-side, "
+              f"{engine.tier_promoted_blocks} promoted back, "
+              f"{engine.tier_dropped_blocks} dropped, "
+              f"{engine.tier_hit_requests} host-hit requests "
+              f"({engine.tier_hit_tokens} tokens recovered), "
+              f"{len(engine.host_tier)} entries / "
+              f"{engine.host_tier.used_bytes >> 10} KiB resident; "
+              f"evictions by reason {engine.evictions_by_reason}")
         if recompiles:
             raise RuntimeError(
                 f"{recompiles} recompilations after warmup — static-shape "

@@ -40,8 +40,12 @@ from .engine import (EngineConfig, Request, RequestResult, ServingEngine,
                      plan_prefill_chunks)
 from .kv_blocks import (BlockAllocator, BlockExhausted, PagedKVPool,
                         QuotaExceeded, init_paged_pool)
+from .kv_tier import (KV_WIRE_VERSION, HostTier, LRUTierPolicy,
+                      QoSTierPolicy, TierPolicy, pack_block, unpack_block,
+                      wire_block_bytes)
 from .paged import (paged_copy_block, paged_decode_span, paged_decode_step,
-                    paged_gather_kv, paged_mixed_step, paged_prefill_step)
+                    paged_gather_kv, paged_mixed_step, paged_prefill_step,
+                    paged_upload_block)
 from .prefix_index import PrefixIndex
 from .qos import (DEFAULT_TENANT, QOS_GUARANTEE, QOS_OPPORTUNISTIC,
                   FairQueue, TenantRegistry, TenantSpec)
@@ -52,8 +56,13 @@ __all__ = [
     "DEFAULT_TENANT",
     "EngineConfig",
     "FairQueue",
+    "HostTier",
+    "KV_WIRE_VERSION",
+    "LRUTierPolicy",
     "PagedKVPool",
     "PrefixIndex",
+    "QoSTierPolicy",
+    "TierPolicy",
     "QOS_GUARANTEE",
     "QOS_OPPORTUNISTIC",
     "QuotaExceeded",
@@ -63,11 +72,15 @@ __all__ = [
     "TenantRegistry",
     "TenantSpec",
     "init_paged_pool",
+    "pack_block",
     "paged_copy_block",
     "paged_decode_span",
     "paged_decode_step",
     "paged_gather_kv",
     "paged_mixed_step",
     "paged_prefill_step",
+    "paged_upload_block",
     "plan_prefill_chunks",
+    "unpack_block",
+    "wire_block_bytes",
 ]

@@ -53,7 +53,19 @@ schedules at TOKEN granularity instead:
   freeing eagerly; eviction drains it only when a reservation would
   otherwise fail (kv_blocks.py) — so the cache uses exactly the HBM
   admission doesn't need, and the emitted streams stay bit-exact with
-  the cache disabled (test-locked, like every other engine property).
+  the cache disabled (test-locked, like every other engine property);
+- KV CACHE TIERING (kv_tier.py, ``host_tier_bytes``): eviction no
+  longer destroys a prefix — the victim subtree's blocks are
+  serialized (versioned wire format) into a byte-budgeted host-RAM
+  tier through a pluggable TierPolicy (LRU, or QoS-aware protecting
+  Guarantee-charged prefixes), the trie keeps the nodes HOST-resident,
+  and a later admission that matches them PROMOTES the payloads back
+  into freshly reserved device blocks via one warmed compiled upload
+  shape, overlapping the copy-in with the pipelined dispatch.  The
+  tenant quota ledger stays honest: demotion releases the device
+  blocks (uncharging their tenant), promotion is a normal charged
+  reservation.  Hit-rate, not HBM, sets the cache ceiling; streams
+  stay bit-exact with tiering off.
 
 Everything device-side is static-shaped — slot count, block tables,
 chunk widths — so after one warmup pass NOTHING recompiles
@@ -97,8 +109,10 @@ from ..utils.promtext import (MetricFamily, MetricServer, Sample,
                               _format_value)
 from .kv_blocks import (BlockAllocator, BlockExhausted, QuotaExceeded,
                         init_paged_pool)
+from .kv_tier import (HostTier, LRUTierPolicy, QoSTierPolicy, pack_block,
+                      unpack_block, wire_block_bytes)
 from .paged import (paged_copy_block, paged_decode_span, paged_mixed_step,
-                    paged_prefill_step)
+                    paged_prefill_step, paged_upload_block)
 from .prefix_index import PrefixIndex
 from .qos import (DEFAULT_TENANT, QOS_GUARANTEE, QOS_OPPORTUNISTIC,
                   FairQueue, TenantRegistry, TenantSpec)
@@ -217,6 +231,20 @@ class EngineConfig:
     # either way; False is the bench's control arm and restores strict
     # prefill priority.
     mixed: bool = True
+    # KV cache tiering (kv_tier.py): a host-RAM byte budget for demoted
+    # prefix blocks.  None = tiering off (evicted prefixes are
+    # destroyed, the pre-tier behavior); set, the allocator's eviction
+    # path SERIALIZES victims into the host tier instead, the trie
+    # keeps their nodes HOST-resident, and admission promotes matched
+    # host blocks back into fresh device blocks through one warmed
+    # compiled upload shape.  Streams are bit-exact either way.
+    # Requires prefix_cache.
+    host_tier_bytes: Optional[int] = None
+    # which TierPolicy drives demote-vs-drop and host victim order:
+    # "lru" (demote all, evict coldest) or "qos" (tenant-aware —
+    # Guarantee-charged host bytes are protected from Opportunistic
+    # pressure, Guarantee pressure drains Opportunistic entries first)
+    tier_policy: str = "lru"
     # per-step cap on the prefill tokens fused into a mixed dispatch —
     # the bound on the extra latency ANY decode lane (a Guarantee
     # tenant's included) pays per admission ride-along.  A plan chunk
@@ -269,6 +297,31 @@ class _Pending:
     # the continuation's first token is a real inter-token stall and
     # must land in the TBT histogram (the metric exists for that tail)
     last_token_at: Optional[float] = None
+
+
+@dataclass
+class _PrefixHit:
+    """One admission's prefix-cache match, tier-aware.  ``start`` is
+    the first token that must prefill; ``shared`` are DEVICE-resident
+    fully matched blocks (retained and mapped for the request's
+    lifetime); ``promote`` are HOST-resident fully matched trie nodes
+    whose payloads upload into the leading freshly reserved blocks
+    (rebound device-resident, shared from then on); exactly one of
+    ``cow_src`` (device partial match — CoW dispatch) / ``host_cow``
+    (host partial match — payload uploaded straight into the private
+    tail block, entry stays host-side for other matchers) may be set.
+    ``needed`` counts the reservation: promoted + private tail + fresh
+    suffix blocks.  ``host_tokens`` is the prompt-token count recovered
+    from host-resident blocks (the tier-hit metric)."""
+
+    start: int
+    shared: List[int]
+    cow_src: Optional[int]
+    promote: List
+    host_cow: Optional[object]
+    plan: List[Tuple[int, int, int]]
+    needed: int
+    host_tokens: int
 
 
 @dataclass
@@ -357,6 +410,15 @@ class ServingEngine:
             raise ValueError(
                 f"mixed_prefill_budget must be >= 1 or None, got "
                 f"{ec.mixed_prefill_budget}")
+        if ec.host_tier_bytes is not None and not ec.prefix_cache:
+            raise ValueError(
+                "host_tier_bytes requires prefix_cache=True — the tier "
+                "spills the radix index; there is nothing to spill "
+                "without it")
+        if ec.tier_policy not in ("lru", "qos"):
+            raise ValueError(
+                f"tier_policy must be 'lru' or 'qos', got "
+                f"{ec.tier_policy!r}")
         # fail fast on a bad filter set, like the dense sampling entries
         _filter_logits(jnp.zeros((1, 2)), ec.top_k, ec.top_p)
         self.params = params
@@ -366,9 +428,31 @@ class ServingEngine:
         self.pool = init_paged_pool(config, ec.num_blocks, ec.block_size)
         self.prefix_index = (PrefixIndex(ec.block_size)
                              if ec.prefix_cache else None)
+        # the tenant registry must exist before the tier policy (the
+        # QoS-aware policy reads class membership from it)
+        self.tenants = tenants or TenantRegistry.default()
+        self.host_tier: Optional[HostTier] = None
+        if ec.host_tier_bytes is not None:
+            full_wire = wire_block_bytes(
+                ec.block_size, config.n_layers, config.kv_heads,
+                ec.block_size, config.head_dim,
+                jnp.dtype(config.dtype).itemsize)
+            if ec.host_tier_bytes < full_wire:
+                raise ValueError(
+                    f"host_tier_bytes {ec.host_tier_bytes} is below one "
+                    f"block's wire size ({full_wire}) — the tier could "
+                    f"never hold a single block")
+            policy = (LRUTierPolicy() if ec.tier_policy == "lru"
+                      else QoSTierPolicy(self.tenants))
+            self.host_tier = HostTier(ec.host_tier_bytes, policy,
+                                      on_drop=self._drop_host_entry)
+            # the index purges a detached host descendant's tier entry
+            # through this hook (evict of a device ancestor, displaced
+            # leaf upgrades)
+            self.prefix_index.host_drop = self.host_tier.forget
         self.allocator = BlockAllocator(
             ec.num_blocks, ec.block_size,
-            evictor=(self.prefix_index.evict if self.prefix_index is not None
+            evictor=(self._evict_blocks if self.prefix_index is not None
                      else None))
         self._table_width = -(-ec.max_request_len // ec.block_size)
         self._slots = [_Slot(i, self._table_width)
@@ -387,7 +471,6 @@ class ServingEngine:
         # (plan + block count computed once at submit; _admit re-plans
         # only on a prefix-cache hit).  The default registry holds one
         # uncapped Guarantee tenant, making this exactly a FIFO.
-        self.tenants = tenants or TenantRegistry.default()
         self._queue = FairQueue(self.tenants)
         self._results: Dict[str, RequestResult] = {}
         # counters (the bench's and the metrics endpoint's raw material):
@@ -406,6 +489,26 @@ class ServingEngine:
         self.prefix_hit_requests = 0
         self.prefix_hit_tokens = 0  # prompt tokens whose prefill was skipped
         self.cow_copies = 0
+        # eviction outcome by reason — the metrics plane's `reason`
+        # label (reservation_pressure / quota_drain name the trigger
+        # when evicted K/V is destroyed; tier_demote / tier_drop name
+        # the tier's verdict when it is consulted instead)
+        self.evictions_by_reason: Dict[str, int] = {
+            "reservation_pressure": 0, "quota_drain": 0,
+            "tier_demote": 0, "tier_drop": 0}
+        # KV tier counters: blocks spilled host-side, blocks copied
+        # back into fresh device blocks (shared rebinds AND private
+        # partial-match copies), host-budget evictions, admissions that
+        # recovered host-resident prefix rows, the tokens they
+        # recovered, and host wall time spent staging promotions
+        # (deserialize + upload enqueue — the dispatch itself overlaps
+        # the pipelined step on an unguarded engine)
+        self.tier_demoted_blocks = 0
+        self.tier_dropped_blocks = 0
+        self.tier_promoted_blocks = 0
+        self.tier_hit_requests = 0
+        self.tier_hit_tokens = 0
+        self.tier_promotion_stall_s = 0.0
         self._ttft_counts = [0] * (len(TTFT_BUCKETS) + 1)  # +Inf tail
         self._ttft_sum = 0.0
         # QoS counters: preemptions by victim tenant, emitted tokens by
@@ -491,6 +594,15 @@ class ServingEngine:
             return paged_copy_block(pk, pv, src, dst)
 
         self._copy_step = jax.jit(copy, donate_argnums=(0, 1))
+
+        # the KV tier's promotion primitive: one block's host payload
+        # into a fresh pool block — like the CoW copy, a single static
+        # shape (dst traced, slab shape fixed), warmed when the tier is
+        # enabled so promotion never compiles mid-serve.
+        def upload(pk, pv, dst, k_slab, v_slab):
+            return paged_upload_block(pk, pv, dst, k_slab, v_slab)
+
+        self._upload_step = jax.jit(upload, donate_argnums=(0, 1))
 
     # ------------------------------------------------------------------
     # public API
@@ -687,6 +799,16 @@ class ServingEngine:
             zero = jnp.zeros((), jnp.int32)
             pk, pv = self._copy_step(self.pool.k, self.pool.v, zero, zero)
             self.pool = replace(self.pool, k=pk, v=pv)
+        if self.host_tier is not None:
+            # the tier's one upload shape: a zero slab into the scratch
+            # block (whose rows are dead by construction)
+            cfg2 = self.model_config
+            slab = jnp.zeros((cfg2.n_layers, cfg2.kv_heads, ec.block_size,
+                              cfg2.head_dim), cfg2.dtype)
+            pk, pv = self._upload_step(
+                self.pool.k, self.pool.v, jnp.zeros((), jnp.int32),
+                slab, slab)
+            self.pool = replace(self.pool, k=pk, v=pv)
         jax.block_until_ready(self.pool.k)
 
     def compile_counts(self) -> Dict[str, int]:
@@ -697,6 +819,7 @@ class ServingEngine:
             "prefill": self._prefill_step._cache_size(),
             "mixed": self._mixed_step._cache_size(),
             "copy": self._copy_step._cache_size(),
+            "upload": self._upload_step._cache_size(),
         }
 
     # ------------------------------------------------------------------
@@ -748,8 +871,54 @@ class ServingEngine:
         hit_tokens.add({}, self.prefix_hit_tokens)
         evicted = MetricFamily(
             "kubeshare_serving_prefix_evicted_blocks_total",
-            "Cached blocks evicted to fund reservations.", "counter")
-        evicted.add({}, self.allocator.evicted_blocks)
+            "Cached blocks evicted to fund reservations, by reason "
+            "(reservation_pressure / quota_drain name the trigger when "
+            "the K/V is destroyed; tier_demote / tier_drop name the "
+            "host tier's verdict when tiering is on).", "counter")
+        for reason in sorted(self.evictions_by_reason):
+            evicted.add({"reason": reason},
+                        self.evictions_by_reason[reason])
+        tier_blocks = MetricFamily(
+            "kubeshare_serving_tier_blocks_total",
+            "Host-tier block movement: demoted (device -> host), "
+            "promoted (host -> device, private partial copies "
+            "included), dropped (policy/budget refused the spill), "
+            "host_evicted (host entries evicted for host-budget room).",
+            "counter")
+        tier_blocks.add({"event": "demoted"}, self.tier_demoted_blocks)
+        tier_blocks.add({"event": "promoted"}, self.tier_promoted_blocks)
+        tier_blocks.add({"event": "dropped"}, self.tier_dropped_blocks)
+        tier_blocks.add({"event": "host_evicted"},
+                        self.host_tier.evicted_blocks
+                        if self.host_tier is not None else 0)
+        tier_req = MetricFamily(
+            "kubeshare_serving_tier_requests_total",
+            "Admitted requests by host-tier outcome (hit = at least "
+            "one prompt block recovered from host RAM).", "counter")
+        tier_req.add({"result": "hit"}, self.tier_hit_requests)
+        tier_req.add({"result": "miss"},
+                     self.requests_admitted - self.tier_hit_requests)
+        tier_tokens = MetricFamily(
+            "kubeshare_serving_tier_hit_tokens_total",
+            "Prompt tokens recovered from host-resident blocks.",
+            "counter")
+        tier_tokens.add({}, self.tier_hit_tokens)
+        tier_bytes = MetricFamily(
+            "kubeshare_serving_tier_host_bytes",
+            "Host-tier occupancy vs budget (serialized wire bytes).",
+            "gauge")
+        tier_bytes.add({"kind": "used"},
+                       self.host_tier.used_bytes
+                       if self.host_tier is not None else 0)
+        tier_bytes.add({"kind": "budget"},
+                       self.host_tier.budget_bytes
+                       if self.host_tier is not None else 0)
+        tier_stall = MetricFamily(
+            "kubeshare_serving_tier_promotion_stall_seconds_total",
+            "Host wall time staging promotions (deserialize + upload "
+            "enqueue; the device copy-in itself overlaps the pipelined "
+            "dispatch on an unguarded engine).", "counter")
+        tier_stall.add({}, self.tier_promotion_stall_s)
         ttft = MetricFamily(
             "kubeshare_serving_ttft_seconds",
             "Time to first token (submit to first emitted token).",
@@ -798,7 +967,8 @@ class ServingEngine:
                 tbt, "kubeshare_serving_tbt_seconds",
                 {"qos": cls}, counts, total, TBT_BUCKETS)
         return [req, blocks, tokens, dispatches, prefix, hit_tokens,
-                evicted, ttft, t_depth, t_blocks, t_tokens, preempt,
+                evicted, tier_blocks, tier_req, tier_tokens, tier_bytes,
+                tier_stall, ttft, t_depth, t_blocks, t_tokens, preempt,
                 cls_ttft, tbt]
 
     def serve_metrics(self, port: int = 0) -> MetricServer:
@@ -829,30 +999,147 @@ class ServingEngine:
         cls[1] += per_token * count
         _bucket_observe(cls[0], per_token, TBT_BUCKETS, count)
 
-    def _match_prefix(self, pending: _Pending) -> Tuple[int, List[int], Optional[int], List[Tuple[int, int, int]], int]:
-        """Admission-time prefix lookup for one queued request: returns
-        (start, shared_blocks, cow_src, plan, fresh_needed).  ``start``
-        is the first token that must prefill (0 = cold); ``shared``
-        are fully reused blocks mapped into the slot's table for the
-        request's lifetime; ``cow_src`` is the partially matched block
-        to copy-on-write (None when the match ends on a block
-        boundary).  The matched-token cap (prompt - 1) keeps at least
-        one real token in the prefill plan — its logits row IS the
-        first output token."""
+    # ------------------------------------------------------------------
+    # KV tiering internals (kv_tier.py owns the store; the engine owns
+    # the glue between allocator eviction, the trie, and the pool)
+    # ------------------------------------------------------------------
+    def _evict_blocks(self, victim: int, reason: str) -> List[int]:
+        """The allocator's eviction callback.  Tiering off: detach the
+        victim's subtree from the trie (the K/V is destroyed) and count
+        the trigger ``reason``.  Tiering on: walk the subtree through
+        the TierPolicy — each node is DEMOTED (serialized into the host
+        tier, trie node kept HOST-resident) or DROPPED (subtree
+        detached, pre-tier behavior).  Either way every device block in
+        the subtree is released to the allocator, which uncharges it
+        from its tenant's quota ledger — a demoted cache stops
+        occupying the HBM budget of whoever brought it in (the quota-
+        honesty fix; re-charging happens at promotion, which is a
+        normal tenant reservation).  Runs UNDER the allocator lock: no
+        locking allocator methods may be called from here."""
+        if self.host_tier is None:
+            removed = self.prefix_index.evict(victim)
+            self.evictions_by_reason[reason] += len(removed)
+            return removed
+        released: List[int] = []
+        # entries demoted WITHIN this walk are pinned until it returns:
+        # the walk goes parent-first, so a just-demoted ancestor
+        # transiently has device-resident children — if a descendant's
+        # put() picked it as the budget victim, _drop_host_entry would
+        # detach a subtree that still holds device blocks (review
+        # regression: crashed under a one-block host budget)
+        walk_pins: List[int] = []
+        try:
+            self._tier_visit(self.prefix_index.node_of(victim), released,
+                             walk_pins)
+        finally:
+            for k in walk_pins:
+                self.host_tier.unpin(k)
+        return released
+
+    def _read_block_payload(self, node) -> bytes:
+        """Serialize one device block's K/V rows + token run.  Reading
+        the pool synchronizes with any in-flight dispatch (the pool
+        arrays are its outputs) — demotion is an eviction-pressure
+        path, not a hot path."""
+        k_slab = np.asarray(self.pool.k[:, node.block])
+        v_slab = np.asarray(self.pool.v[:, node.block])
+        return pack_block(node.tokens, k_slab, v_slab)
+
+    def _tier_visit(self, root, released: List[int],
+                    walk_pins: List[int]) -> None:
+        """Demote-or-drop every device-resident node in ``root``'s
+        subtree, parent-first (host children are already spilled).  A
+        dropped node takes its whole subtree with it — descendants
+        below a detached node could never be matched again, so demoting
+        them would only leak host bytes.  Demoted keys are pinned into
+        ``walk_pins`` (released by the caller): the parent-first order
+        means a demoted ancestor still has device children mid-walk,
+        and the tier must not evict it to fund them.  Iterative like
+        ``PrefixIndex.detach`` — subtree depth is bounded only by
+        ``max_request_len / block_size``, far past Python's recursion
+        limit on long-context configs."""
+        stack = [root] if root is not None else []
+        while stack:
+            node = stack.pop()
+            # under the allocator lock: read the charge ledger directly
+            tenant = self.allocator._tenant_of.get(node.block)
+            key = self.host_tier.put(self._read_block_payload(node),
+                                     tenant, node)
+            if key is None:
+                device, host_keys = self.prefix_index.detach(node)
+                for hk in host_keys:
+                    self.host_tier.forget(hk)
+                released.extend(device)
+                self.tier_dropped_blocks += len(device)
+                self.evictions_by_reason["tier_drop"] += len(device)
+                continue
+            self.host_tier.pin(key)
+            walk_pins.append(key)
+            released.append(node.block)
+            self.prefix_index.demote(node.block, key)
+            self.tier_demoted_blocks += 1
+            self.evictions_by_reason["tier_demote"] += 1
+            stack.extend(
+                child
+                for child in list(node.children.values()) + node.partials
+                if child.host_key is None)
+
+    def _drop_host_entry(self, entry) -> None:
+        """HostTier's budget-eviction hook: a host entry leaving the
+        store must take its trie node (and the node's all-host subtree)
+        with it — the cascade's forgets free the bytes."""
+        device, host_keys = self.prefix_index.detach(entry.node)
+        if device:  # host-below-device invariant violated
+            raise RuntimeError(
+                f"host entry {entry.key}'s subtree held device blocks "
+                f"{device} — index/tier state diverged")
+        for hk in host_keys:
+            self.host_tier.forget(hk)
+
+    def _match_prefix(self, pending: _Pending) -> Optional[_PrefixHit]:
+        """Admission-time prefix lookup for one queued request (None =
+        cold).  The tier-aware trie walk may cross HOST-resident nodes:
+        device full matches map as shared blocks, host full matches
+        become promotions, and a partial tail match routes to the CoW
+        copy (device) or a private payload upload (host).  The matched-
+        token cap (prompt - 1) keeps at least one real token in the
+        prefill plan — its logits row IS the first output token."""
         ec = self.engine_config
         prompt = pending.prompt
-        matched, mblocks = self.prefix_index.match(prompt)
+        matched, chain = self.prefix_index.match_tiered(prompt)
         matched = min(matched, prompt.size - 1)
         if matched <= 0:
-            return 0, [], None, [], 0
-        mblocks = mblocks[: self.allocator.blocks_for_tokens(matched)]
-        n_keep = matched // ec.block_size
-        cow_src = mblocks[n_keep] if matched % ec.block_size else None
+            return None
+        chain = chain[: self.allocator.blocks_for_tokens(matched)]
+        n_full = matched // ec.block_size
+        partial = matched % ec.block_size
+        shared: List[int] = []
+        promote: List = []
+        for node in chain[:n_full]:
+            if node.host_key is None:
+                if promote:  # host-ness is downward-closed on paths
+                    raise RuntimeError(
+                        "device-resident node below a host-resident one "
+                        "in a match chain — index/tier state diverged")
+                shared.append(node.block)
+            else:
+                promote.append(node)
+        cow_src = host_cow = None
+        if partial:
+            tail = chain[n_full]
+            if tail.host_key is None:
+                cow_src = tail.block
+            else:
+                host_cow = tail
         plan, cover = plan_prefill_chunks(
             prompt.size, ec.prefill_chunk, ec.max_request_len, matched)
         total_rows = max(cover, prompt.size + pending.max_new)
-        fresh = self.allocator.blocks_for_tokens(total_rows) - n_keep
-        return matched, mblocks[:n_keep], cow_src, plan, fresh
+        needed = (self.allocator.blocks_for_tokens(total_rows)
+                  - len(shared))
+        host_tokens = (len(promote) * ec.block_size
+                       + (partial if host_cow is not None else 0))
+        return _PrefixHit(matched, shared, cow_src, promote, host_cow,
+                          plan, needed, host_tokens)
 
     def _admit(self) -> None:
         """QoS admission: walk tenants in fair-queue order (Guarantee
@@ -930,12 +1217,12 @@ class ServingEngine:
                 pending.needed, spec.name, spec.kv_block_quota):
             return False  # the cold fallback fits
         if self.prefix_index is not None:
-            start, shared, cow_src, _, hit_needed = \
-                self._match_prefix(pending)
-            if start and self.allocator.quota_can_fit(
-                    hit_needed, spec.name, spec.kv_block_quota,
-                    keep=shared + ([cow_src] if cow_src is not None
-                                   else [])):
+            hit = self._match_prefix(pending)
+            if hit is not None and self.allocator.quota_can_fit(
+                    hit.needed, spec.name, spec.kv_block_quota,
+                    keep=hit.shared + ([hit.cow_src]
+                                       if hit.cow_src is not None
+                                       else [])):
                 return False
         return True
 
@@ -946,16 +1233,26 @@ class ServingEngine:
         "pool" (global shortfall).  A failed attempt rolls back every
         retained block."""
         plan, needed = pending.plan, pending.needed
-        start, shared, cow_src = 0, [], None
-        if self.prefix_index is not None:
-            start, shared, cow_src, hit_plan, hit_needed = \
-                self._match_prefix(pending)
-            if start:
-                plan, needed = hit_plan, hit_needed
+        hit = (self._match_prefix(pending)
+               if self.prefix_index is not None else None)
+        if hit is not None:
+            plan, needed = hit.plan, hit.needed
         evict_first = (set(self.tenants.opportunistic())
                        if spec.is_guarantee else None)
         while True:
+            shared = hit.shared if hit is not None else []
+            cow_src = hit.cow_src if hit is not None else None
             retained = shared + ([cow_src] if cow_src is not None else [])
+            pinned: List[int] = []
+            if hit is not None and self.host_tier is not None:
+                # the reserve below may demote MORE blocks into the
+                # host tier, and the tier's budget eviction must not
+                # take the entries this admission is about to promote
+                pinned = [n.host_key for n in hit.promote]
+                if hit.host_cow is not None:
+                    pinned.append(hit.host_cow.host_key)
+                for k in pinned:
+                    self.host_tier.pin(k)
             if retained:
                 self.allocator.retain(retained)
             try:
@@ -965,9 +1262,11 @@ class ServingEngine:
                     evict_tenants_first=evict_first)
                 break
             except QuotaExceeded:
+                for k in pinned:
+                    self.host_tier.unpin(k)
                 if retained:
                     self.allocator.reclaim(retained)
-                if start:
+                if hit is not None:
                     # a prefix HIT can be quota-infeasible where a cold
                     # admission is not: the retained chain (+ transient
                     # CoW source) pins charged blocks the quota drain
@@ -976,37 +1275,84 @@ class ServingEngine:
                     # Retry cold — the hit saves FLOPs, never
                     # correctness, and the cold reserve may now evict
                     # the matched chain itself.
-                    start, shared, cow_src = 0, [], None
+                    hit = None
                     plan, needed = pending.plan, pending.needed
                     continue
                 return "quota"
             except BlockExhausted:
+                for k in pinned:
+                    self.host_tier.unpin(k)
                 if retained:
                     self.allocator.reclaim(retained)
                 return "pool"
         slot.state = "prefill"
         slot.rid = pending.rid
         slot.tenant = spec.name
-        # table order: [shared prefix blocks | CoW copy (blocks[0],
-        # when the match ends mid-block) | fresh suffix blocks]
+        # table order: [device shared prefix | promoted host blocks
+        # (blocks[:n_promote], chain order) | CoW / host-partial copy
+        # (blocks[n_promote], when the match ends mid-block) | fresh
+        # suffix blocks]
+        n_promote = len(hit.promote) if hit is not None else 0
         slot.blocks = shared + blocks
         slot.table[:] = 0
         slot.table[: len(slot.blocks)] = slot.blocks
         slot.length = 0
+        if n_promote or (hit is not None and hit.host_cow is not None):
+            # PROMOTION: host payloads back into fresh device blocks.
+            # Each upload is one warmed compiled shape dispatched
+            # through the pipelined path — on an unguarded engine the
+            # copy-in overlaps the in-flight decode dispatch, so lanes
+            # keep advancing while the prefix re-materializes.  The
+            # stall counter records the host-side staging time
+            # (deserialize + enqueue; plus device sync when guarded).
+            t0 = time.monotonic()
+            for node, dst in zip(hit.promote, blocks[:n_promote]):
+                entry = self.host_tier.take(node.host_key)
+                _, k_slab, v_slab = unpack_block(entry.payload)
+                pk, pv = self._dispatch(
+                    self._upload_step, self.pool.k, self.pool.v,
+                    jnp.asarray(dst, jnp.int32),
+                    jnp.asarray(k_slab), jnp.asarray(v_slab))
+                self.pool = replace(self.pool, k=pk, v=pv)
+                self.prefix_index.promote(node, dst)
+            if n_promote:
+                # promoted blocks are trie-referenced again: park
+                # idle-cached at release, like any indexed block.  The
+                # reserve above already re-charged them to the tenant.
+                self.allocator.mark_cached(blocks[:n_promote])
+            if hit.host_cow is not None:
+                # host partial match: the payload goes STRAIGHT into
+                # the request's private tail block (the host twin of
+                # the CoW copy); the entry stays host-side serving
+                # other matchers
+                entry = self.host_tier.peek(hit.host_cow.host_key)
+                _, k_slab, v_slab = unpack_block(entry.payload)
+                pk, pv = self._dispatch(
+                    self._upload_step, self.pool.k, self.pool.v,
+                    jnp.asarray(blocks[n_promote], jnp.int32),
+                    jnp.asarray(k_slab), jnp.asarray(v_slab))
+                self.pool = replace(self.pool, k=pk, v=pv)
+            self.tier_promoted_blocks += n_promote + (
+                1 if hit.host_cow is not None else 0)
+            self.tier_promotion_stall_s += time.monotonic() - t0
+            self.tier_hit_requests += 1
+            self.tier_hit_tokens += hit.host_tokens
+        for k in pinned:
+            self.host_tier.unpin(k)
         if cow_src is not None:
             pk, pv = self._dispatch(
                 self._copy_step, self.pool.k, self.pool.v,
                 jnp.asarray(cow_src, jnp.int32),
-                jnp.asarray(blocks[0], jnp.int32))
+                jnp.asarray(blocks[n_promote], jnp.int32))
             self.pool = replace(self.pool, k=pk, v=pv)
             self.allocator.reclaim([cow_src])  # transient read ref
             self.cow_copies += 1
-        if start:
+        if hit is not None:
             # honest skip count: the bucketed tail may slide BELOW
             # the match point (or a tiny prompt replans from 0),
             # re-prefilling cached rows — only rows no plan chunk
             # rewrites were actually skipped
-            skipped = min(start, min(s for s, _, _ in plan))
+            skipped = min(hit.start, min(s for s, _, _ in plan))
             self.prefix_hit_requests += 1
             self.prefix_hit_tokens += skipped
         self.requests_admitted += 1

@@ -114,12 +114,18 @@ class BlockAllocator:
       :class:`BlockExhausted` — the cache uses exactly the HBM that
       admission doesn't need, and gives it back the moment it does.
 
-    Eviction is delegated to ``evictor`` (the prefix index): it must
-    detach the victim from the trie and return every block released
-    (the victim's whole subtree — an idle parent's descendants are idle
-    too, because every reader retains the full chain).  The allocator
-    verifies each returned block really was idle-cached; a live block
-    coming back from the evictor is a corruption, not a policy choice.
+    Eviction is delegated to ``evictor`` (the engine's wrapper over the
+    prefix index — or over the host tier's demotion path, when KV
+    tiering is on): called as ``evictor(victim, reason)`` where
+    ``reason`` names the trigger (``"reservation_pressure"`` for the
+    shortfall drain, ``"quota_drain"`` for a tenant's own-cache drain —
+    the metrics plane's eviction-``reason`` label), it must release the
+    victim's DEVICE block (and its subtree's — an idle parent's
+    descendants are idle too, because every reader retains the full
+    chain) and return every block released, whether the blocks' K/V
+    was destroyed or demoted host-side.  The allocator verifies each
+    returned block really was idle-cached; a live block coming back
+    from the evictor is a corruption, not a policy choice.
 
     **Tenant charging** (the QoS subsystem's HBM ledger): a reservation
     made with ``tenant=`` charges every granted block to that tenant
@@ -138,7 +144,7 @@ class BlockAllocator:
     """
 
     def __init__(self, num_blocks: int, block_size: int,
-                 evictor: Optional[Callable[[int], List[int]]] = None
+                 evictor: Optional[Callable[[int, str], List[int]]] = None
                  ) -> None:
         if num_blocks < 2:
             raise ValueError(
@@ -221,12 +227,15 @@ class BlockAllocator:
             if not self._usage[tenant]:
                 del self._usage[tenant]
 
-    def _evict_locked(self, victim: int) -> None:
+    def _evict_locked(self, victim: int, reason: str) -> None:
         """Detach ``victim`` (and its subtree, via the evictor) from the
         cache: every released block moves idle -> free and drops its
-        tenant charge.  Caller holds the lock and has verified the
-        victim is idle-cached."""
-        removed = (self.evictor(victim) if self.evictor is not None
+        tenant charge — whether the evictor destroyed the K/V or
+        demoted it host-side, the DEVICE HBM (and the tenant's quota
+        charge for it) is given back either way.  ``reason`` names the
+        trigger for the metrics plane.  Caller holds the lock and has
+        verified the victim is idle-cached."""
+        removed = (self.evictor(victim, reason) if self.evictor is not None
                    else [victim])
         if victim not in removed:
             raise RuntimeError(
@@ -299,7 +308,7 @@ class BlockAllocator:
                         if self._usage.get(tenant, 0) + count <= quota:
                             break
                         if b in self._idle:  # prior subtree may cover it
-                            self._evict_locked(b)
+                            self._evict_locked(b, "quota_drain")
                 if self._usage.get(tenant, 0) + count > quota:
                     raise QuotaExceeded(
                         f"request {owner!r} needs {count} blocks but "
@@ -327,7 +336,7 @@ class BlockAllocator:
                         if self._tenant_of.get(b) in evict_tenants_first:
                             victim = b
                             break
-                self._evict_locked(victim)
+                self._evict_locked(victim, "reservation_pressure")
             # the up-front doomed-check plus the drain loop guarantee
             # the free list can now fund the reservation (eviction
             # conserves free + idle)

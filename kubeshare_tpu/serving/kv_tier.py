@@ -1,0 +1,359 @@
+"""Host-RAM KV block tier: spill/promote under the radix prefix index.
+
+PR 2's radix trie makes retired prompts reusable, but its capacity is
+HBM-bounded: when ``BlockAllocator.reserve`` drains the idle-cached LRU
+pool, evicted prefixes are destroyed — with millions of users the
+working set of system prompts and templates can never exceed the device
+pool.  This module adds the missing tier: an evicted block's K/V rows
+are SERIALIZED into a defined wire format and parked in a byte-budgeted
+host-RAM store (:class:`HostTier`) instead of being dropped; the trie
+node stays in the index marked HOST-resident, so a later prompt's
+admission walk still matches it and PROMOTES the payload back into a
+freshly reserved device block.  Hit-rate, not HBM, sets the cache
+ceiling.
+
+Three pieces, deliberately decoupled:
+
+- the **wire format** (:func:`pack_block` / :func:`unpack_block`): one
+  block's K and V slabs (all layers) plus its token-id run behind a
+  versioned, magic-tagged header.  Versioning is the point — the same
+  bytes are the unit a later PR ships across slices for disaggregated
+  prefill/decode (ROADMAP), so the format must outlive this module's
+  in-process use.  Round-trips are bit-identical (test-locked);
+- the **store** (:class:`HostTier`): a budgeted dict of serialized
+  blocks keyed by an opaque handle, LRU-ordered, with a pin set so
+  entries an in-progress admission is about to promote can never be
+  evicted out from under it.  The budget is enforced by evicting
+  unpinned entries through the policy; pinned entries make it a soft
+  cap (transient overage is host RAM, not HBM);
+- the **policy** (:class:`TierPolicy`): the demote-vs-drop decision and
+  the host-side victim order, pluggable in the spirit of gpu_ext's
+  extensible-OS-policy argument (PAPERS.md).  :class:`LRUTierPolicy`
+  demotes everything and evicts coldest-first; :class:`QoSTierPolicy`
+  rides the tenant registry — host entries charged to Guarantee tenants
+  are protected from Opportunistic pressure (an Opportunistic demotion
+  that could only fit by evicting Guarantee bytes is dropped instead),
+  while Guarantee pressure evicts Opportunistic entries first.
+
+The engine owns the glue (engine.py): demotion happens inside the
+allocator's eviction callback (the block's device HBM is released and
+the tenant's quota charge drops with it — the cache stops occupying the
+quota of whoever brought it in), promotion rides admission (the
+promoted block is a normal reservation, so the tenant is re-charged,
+and the copy-in is ONE warmed compiled upload shape dispatched through
+the same pipelined path as every other step — decode lanes keep
+advancing while the host payload uploads).  Streams are bit-exact with
+tiering off, test-locked like every other engine property.
+"""
+
+from __future__ import annotations
+
+import struct
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+# Wire format: magic + version first, so a receiver (this module today,
+# a cross-slice migration endpoint later) can reject foreign bytes
+# loudly before trusting a single field.
+KV_WIRE_MAGIC = b"KVWB"
+KV_WIRE_VERSION = 1
+# magic, version, header_len, n_layers, kv_heads, block_size, head_dim,
+# n_tokens, reserved, dtype NAME (ascii, NUL-padded).  The name (not
+# numpy's ``.str`` tag) is deliberate: extension dtypes like bfloat16
+# stringify as opaque void tags ('<V2') that cannot round-trip, while
+# 'bfloat16' resolves through ml_dtypes on any receiver.  Slabs are
+# always little-endian on the wire (ascii names carry no byte order).
+_HEADER = struct.Struct("<4sHHHHHHHH16s")
+
+
+def _dtype_from_name(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # jax dependency: bfloat16, fp8 families
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def wire_block_bytes(n_tokens: int, n_layers: int, kv_heads: int,
+                     block_size: int, head_dim: int, itemsize: int) -> int:
+    """Exact serialized size of one block — what a budget admission
+    check needs WITHOUT materializing the payload."""
+    return (_HEADER.size + 4 * n_tokens
+            + 2 * n_layers * kv_heads * block_size * head_dim * itemsize)
+
+
+def pack_block(tokens, k_slab: np.ndarray, v_slab: np.ndarray) -> bytes:
+    """Serialize one pool block: K/V slabs ``[n_layers, kv_heads,
+    block_size, head_dim]`` plus the token ids its filled rows hold
+    (``len(tokens) <= block_size``; a partial leaf's stale tail rows
+    ride along — promotion restores them and prefill overwrites them
+    before any causal band can attend, the same write-then-attend
+    argument the CoW copy leans on)."""
+    toks = np.ascontiguousarray(np.asarray(tokens, np.int32))
+    if k_slab.shape != v_slab.shape or k_slab.dtype != v_slab.dtype:
+        raise ValueError(
+            f"K/V slab mismatch: {k_slab.shape}/{k_slab.dtype} vs "
+            f"{v_slab.shape}/{v_slab.dtype}")
+    if k_slab.ndim != 4:
+        raise ValueError(
+            f"slab must be [n_layers, kv_heads, block_size, head_dim], "
+            f"got shape {k_slab.shape}")
+    n_layers, kv_heads, block_size, head_dim = k_slab.shape
+    if not 0 < toks.size <= block_size:
+        raise ValueError(
+            f"{toks.size} tokens do not fit a {block_size}-row block")
+    if k_slab.dtype.byteorder == ">":
+        raise ValueError("big-endian slabs are not wire-encodable")
+    dt = k_slab.dtype.name.encode("ascii")
+    if len(dt) > 16:
+        raise ValueError(f"dtype name {dt!r} over 16 bytes")
+    header = _HEADER.pack(
+        KV_WIRE_MAGIC, KV_WIRE_VERSION, _HEADER.size, n_layers, kv_heads,
+        block_size, head_dim, toks.size, 0, dt.ljust(16, b"\0"))
+    return b"".join([
+        header, toks.tobytes(),
+        np.ascontiguousarray(k_slab).tobytes(),
+        np.ascontiguousarray(v_slab).tobytes()])
+
+
+def unpack_block(buf: bytes) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Inverse of :func:`pack_block`: ``(tokens, k_slab, v_slab)``.
+    Bit-identical round-trip (test-locked); loud on foreign magic or a
+    version this build does not speak."""
+    if len(buf) < _HEADER.size:
+        raise ValueError(f"wire block truncated at {len(buf)} bytes")
+    (magic, version, header_len, n_layers, kv_heads, block_size,
+     head_dim, n_tokens, _reserved, dt) = _HEADER.unpack_from(buf)
+    if magic != KV_WIRE_MAGIC:
+        raise ValueError(f"bad wire magic {magic!r}")
+    if version != KV_WIRE_VERSION:
+        raise ValueError(
+            f"wire version {version} unsupported (this build speaks "
+            f"{KV_WIRE_VERSION})")
+    dtype = _dtype_from_name(dt.rstrip(b"\0").decode("ascii"))
+    expect = wire_block_bytes(n_tokens, n_layers, kv_heads, block_size,
+                              head_dim, dtype.itemsize)
+    if len(buf) != expect:
+        raise ValueError(
+            f"wire block is {len(buf)} bytes, header promises {expect}")
+    off = header_len
+    tokens = np.frombuffer(buf, np.int32, n_tokens, off).copy()
+    off += 4 * n_tokens
+    slab = (n_layers, kv_heads, block_size, head_dim)
+    count = n_layers * kv_heads * block_size * head_dim
+    k = np.frombuffer(buf, dtype, count, off).reshape(slab).copy()
+    off += count * dtype.itemsize
+    v = np.frombuffer(buf, dtype, count, off).reshape(slab).copy()
+    return tokens, k, v
+
+
+class HostEntry:
+    """One demoted block living host-side: the serialized payload, the
+    tenant its device HBM was charged to (the policy's protection key),
+    and the trie node still pointing at it."""
+
+    __slots__ = ("key", "payload", "tenant", "node", "nbytes")
+
+    def __init__(self, key: int, payload: bytes, tenant: Optional[str],
+                 node) -> None:
+        self.key = key
+        self.payload = payload
+        self.tenant = tenant
+        self.node = node
+        self.nbytes = len(payload)
+
+
+class TierPolicy:
+    """Demote-vs-drop and host-victim-order decisions, pluggable.
+
+    ``should_demote(tenant)`` gates a device eviction's spill (False =
+    the block is destroyed, exactly the pre-tier behavior);
+    ``select_victims(tier, need_bytes, incoming_tenant)`` names host
+    entries to evict so ``need_bytes`` more can fit, oldest-preferred,
+    or None when the policy refuses to make room (the incoming block is
+    dropped instead).  Victims must skip pinned entries — the tier
+    enforces this again, but a policy that names pinned keys just
+    wastes its own eviction budget."""
+
+    def should_demote(self, tenant: Optional[str]) -> bool:
+        return True
+
+    def select_victims(self, tier: "HostTier", need_bytes: int,
+                       incoming_tenant: Optional[str]
+                       ) -> Optional[List[int]]:
+        raise NotImplementedError
+
+
+class LRUTierPolicy(TierPolicy):
+    """Demote everything; evict the coldest unpinned host entries
+    first — the host twin of the device pool's idle-LRU drain."""
+
+    def select_victims(self, tier, need_bytes, incoming_tenant):
+        victims, freed = [], 0
+        for key, entry in tier.iter_lru():
+            if tier.is_pinned(key):
+                continue
+            victims.append(key)
+            freed += entry.nbytes
+            if freed >= need_bytes:
+                return victims
+        return victims if freed >= need_bytes else None
+
+
+class QoSTierPolicy(TierPolicy):
+    """Tenant-aware tier policy over the QoS registry: host bytes
+    charged to Guarantee tenants are protected capital.
+
+    - any tenant's blocks MAY demote (host residency is cheap);
+    - an incoming block charged to an Opportunistic tenant (or to
+      nobody) may only evict OTHER Opportunistic entries — if only
+      Guarantee bytes could make room, the incoming block is dropped;
+    - an incoming Guarantee block evicts Opportunistic entries first
+      (LRU within the class), Guarantee entries only as a last resort —
+      the paper's class asymmetry applied to the host tier, the same
+      shape as ``reserve(evict_tenants_first=)`` on the device pool.
+    """
+
+    def __init__(self, registry) -> None:
+        self.registry = registry
+
+    def _is_guarantee(self, tenant: Optional[str]) -> bool:
+        if tenant is None:
+            return False
+        try:
+            return self.registry.get(tenant).is_guarantee
+        except KeyError:
+            return False
+
+    def select_victims(self, tier, need_bytes, incoming_tenant):
+        victims, chosen, freed = [], set(), 0
+        passes = [False] if not self._is_guarantee(incoming_tenant) \
+            else [False, True]
+        for take_guarantee in passes:
+            for key, entry in tier.iter_lru():
+                if tier.is_pinned(key) or key in chosen:
+                    continue
+                if self._is_guarantee(entry.tenant) != take_guarantee:
+                    continue
+                victims.append(key)
+                chosen.add(key)
+                freed += entry.nbytes
+                if freed >= need_bytes:
+                    return victims
+        return victims if freed >= need_bytes else None
+
+
+class HostTier:
+    """The byte-budgeted host-RAM block store.
+
+    Engine-loop confined (no lock: every call happens on the engine's
+    single scheduling thread, some under the allocator's lock).
+    ``on_drop`` is the engine's detach hook: evicting a host entry must
+    also remove its trie node (and the node's all-host subtree — a
+    child's K/V is only valid on top of a cached prefix), which in turn
+    forgets the subtree's entries here; the ``key in entries`` guards
+    below make that reentrant cascade safe."""
+
+    def __init__(self, budget_bytes: int, policy: TierPolicy,
+                 on_drop: Optional[Callable[[HostEntry], None]] = None
+                 ) -> None:
+        if budget_bytes < 1:
+            raise ValueError(
+                f"budget_bytes must be >= 1, got {budget_bytes}")
+        self.budget_bytes = budget_bytes
+        self.policy = policy
+        self.on_drop = on_drop
+        self._entries: "OrderedDict[int, HostEntry]" = OrderedDict()
+        self._pinned: Set[int] = set()
+        self._next_key = 0
+        self.used_bytes = 0
+        self.peak_bytes = 0
+        # lifetime counters (the metrics plane's raw material)
+        self.stored_blocks = 0    # entries ever demoted in
+        self.evicted_blocks = 0   # entries evicted for host budget room
+        self.refused_blocks = 0   # puts the policy/budget refused
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def iter_lru(self):
+        """Entries coldest-first (snapshot — eviction mutates)."""
+        return list(self._entries.items())
+
+    def is_pinned(self, key: int) -> bool:
+        return key in self._pinned
+
+    def pin(self, key: int) -> None:
+        """Protect an entry an admission is about to promote: budget
+        eviction (and the policy) must never take it mid-admission."""
+        self._pinned.add(key)
+
+    def unpin(self, key: int) -> None:
+        self._pinned.discard(key)
+
+    def put(self, payload: bytes, tenant: Optional[str], node
+            ) -> Optional[int]:
+        """Store one serialized block; returns its handle, or None when
+        the policy refuses / room cannot be made (caller drops the
+        block — the pre-tier destroy path)."""
+        need = len(payload)
+        if need > self.budget_bytes or not self.policy.should_demote(tenant):
+            self.refused_blocks += 1
+            return None
+        while self.used_bytes + need > self.budget_bytes:
+            shortfall = self.used_bytes + need - self.budget_bytes
+            victims = self.policy.select_victims(self, shortfall, tenant)
+            if not victims:
+                self.refused_blocks += 1
+                return None
+            before = len(self._entries)
+            for key in victims:
+                entry = self._entries.get(key)
+                if entry is None or key in self._pinned:
+                    continue  # a cascade already took it / protected
+                if self.on_drop is not None:
+                    self.on_drop(entry)  # detaches the trie subtree,
+                    # which forgets this entry (and any descendants)
+                else:
+                    self.forget(key)
+            evicted = before - len(self._entries)
+            if evicted <= 0:
+                self.refused_blocks += 1
+                return None  # no progress — everything left is pinned
+            self.evicted_blocks += evicted
+        key = self._next_key
+        self._next_key += 1
+        self._entries[key] = HostEntry(key, payload, tenant, node)
+        self.used_bytes += need
+        self.peak_bytes = max(self.peak_bytes, self.used_bytes)
+        self.stored_blocks += 1
+        return key
+
+    def peek(self, key: int) -> HostEntry:
+        """Read an entry WITHOUT removing it (a partial host match
+        copies the payload into a private block; the entry keeps
+        serving other matchers) — touches LRU recency."""
+        entry = self._entries[key]
+        self._entries.move_to_end(key)
+        return entry
+
+    def take(self, key: int) -> HostEntry:
+        """Remove and return an entry — promotion moved its bytes back
+        into a device block; the host copy is surplus."""
+        entry = self._entries.pop(key)
+        self.used_bytes -= entry.nbytes
+        self._pinned.discard(key)
+        return entry
+
+    def forget(self, key: int) -> bool:
+        """Drop an entry without ceremony (its trie node was detached
+        elsewhere).  Idempotent — cascades may race ahead."""
+        entry = self._entries.pop(key, None)
+        if entry is None:
+            return False
+        self.used_bytes -= entry.nbytes
+        self._pinned.discard(key)
+        return True

@@ -90,6 +90,24 @@ def paged_copy_block(pool_k, pool_v, src, dst):
             pool_v.at[:, dst].set(pool_v[:, src]))
 
 
+def paged_upload_block(pool_k, pool_v, dst, k_slab, v_slab):
+    """Write ONE block's rows (all layers, K and V) from host slabs —
+    the KV tier's promotion primitive (kv_tier.py).
+
+    ``k_slab``/``v_slab`` are a demoted block's deserialized payload,
+    shape [n_layers, kv_heads, block_size, head_dim]; ``dst`` rides as
+    a TRACED scalar so the jitted upload compiles exactly once (the
+    slab shape is static — one block, like ``paged_copy_block``), and
+    warmup covers it: promotion adds ZERO compiled shapes after the
+    warmed one.  Rows past the payload's filled token count are the
+    demoted block's stale tail; prefill overwrites them before any
+    causal band can attend (the same write-then-attend order that makes
+    the CoW copy's surplus rows dead).
+    """
+    return (pool_k.at[:, dst].set(k_slab),
+            pool_v.at[:, dst].set(v_slab))
+
+
 def _layer_views(pk_layer, pv_layer, tables, config: TransformerConfig):
     """Per-lane virtual K/V views for ONE layer: pool [B, h_kv, bs, d]
     gathered through lane tables [P, T] -> [P, h_kv, T*bs, d].  The one

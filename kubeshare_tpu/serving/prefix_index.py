@@ -37,11 +37,24 @@ idle-cached LRU), eviction is driven by the allocator calling
 detaches the victim's node AND its whole subtree (an idle parent's
 descendants are idle too: every matcher retains the full chain, so a
 child can never outlive its parent's last reference).
+
+**Tiering (kv_tier.py):** a node is DEVICE-resident (``block`` is a
+pool id, ``host_key`` is None) or HOST-resident (``block`` is -1,
+``host_key`` names its serialized payload in the engine's
+:class:`~kubeshare_tpu.serving.kv_tier.HostTier`).  Demotion keeps the
+node IN the trie — that is the whole point: a later prompt's
+:meth:`match_tiered` walk still finds it and the engine promotes the
+payload back into a fresh device block.  Host-ness is downward-closed
+on every root-to-leaf path (demotion spills whole subtrees, promotion
+re-devices root-contiguous match prefixes), so a device node never
+hangs below a host node — :meth:`detach` of a host node releases no
+device blocks, ever.  :meth:`match` keeps its pre-tier contract
+(device-resident chain only), so every tiering-off caller is untouched.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 
 def _lcp(a: Sequence[int], b: Sequence[int]) -> int:
@@ -53,7 +66,8 @@ def _lcp(a: Sequence[int], b: Sequence[int]) -> int:
 
 
 class _Node:
-    __slots__ = ("tokens", "block", "parent", "children", "partials")
+    __slots__ = ("tokens", "block", "parent", "children", "partials",
+                 "host_key")
 
     def __init__(self, tokens: Tuple[int, ...], block: int,
                  parent: Optional["_Node"]) -> None:
@@ -64,6 +78,12 @@ class _Node:
         self.children: Dict[Tuple[int, ...], "_Node"] = {}
         # partially-filled leaf children (filled < block_size)
         self.partials: List["_Node"] = []
+        # HostTier handle when demoted (None = device-resident)
+        self.host_key: Optional[int] = None
+
+    @property
+    def location(self) -> str:
+        return "device" if self.host_key is None else "host"
 
 
 class PrefixIndex:
@@ -79,9 +99,16 @@ class PrefixIndex:
         self.block_size = block_size
         self._root = _Node((), -1, None)
         self._by_block: Dict[int, _Node] = {}
+        # engine-installed hook (HostTier.forget): called with a host
+        # key whenever this index detaches a HOST-resident node as a
+        # side effect of evicting a device ancestor or displacing an
+        # upgraded leaf — the tier entry must not outlive its node.
+        self.host_drop: Optional[Callable[[int], bool]] = None
 
     @property
     def cached_blocks(self) -> int:
+        """DEVICE-resident cached blocks (host entries count in the
+        tier's own accounting)."""
         return len(self._by_block)
 
     @property
@@ -90,10 +117,12 @@ class PrefixIndex:
 
     # ------------------------------------------------------------------
     def match(self, tokens) -> Tuple[int, List[int]]:
-        """Longest cached prefix of ``tokens``: (matched_token_count,
-        blocks) where ``blocks[i]`` holds rows ``i*bs .. i*bs+bs-1`` and
-        the LAST block may be matched only partially
-        (``matched % block_size`` rows) — the engine's CoW trigger."""
+        """Longest DEVICE-resident cached prefix of ``tokens``:
+        (matched_token_count, blocks) where ``blocks[i]`` holds rows
+        ``i*bs .. i*bs+bs-1`` and the LAST block may be matched only
+        partially (``matched % block_size`` rows) — the engine's CoW
+        trigger.  Host-resident nodes end the walk (pre-tier contract;
+        :meth:`match_tiered` is the walk that crosses them)."""
         bs = self.block_size
         toks = [int(t) for t in tokens]
         node = self._root
@@ -101,7 +130,7 @@ class PrefixIndex:
         pos = 0
         while len(toks) - pos >= bs:
             child = node.children.get(tuple(toks[pos: pos + bs]))
-            if child is None:
+            if child is None or child.host_key is not None:
                 break
             blocks.append(child.block)
             pos += bs
@@ -115,7 +144,7 @@ class PrefixIndex:
         best, best_block = 0, -1
         if rem:
             for child in list(node.children.values()) + node.partials:
-                if child.tokens[0] != rem[0]:
+                if child.host_key is not None or child.tokens[0] != rem[0]:
                     continue
                 l = _lcp(child.tokens, rem)
                 if l > best:
@@ -124,6 +153,40 @@ class PrefixIndex:
             blocks.append(best_block)
             pos += best
         return pos, blocks
+
+    def match_tiered(self, tokens) -> Tuple[int, List[_Node]]:
+        """:meth:`match` that crosses HOST-resident nodes: returns
+        (matched_token_count, node chain) where each node is device- or
+        host-resident (``node.location``) and the last may be matched
+        only partially.  The engine maps device nodes straight into the
+        slot's table, PROMOTES full-matched host nodes into fresh
+        blocks, and copies a partially matched node's rows (device: CoW
+        dispatch; host: payload upload) into a private block."""
+        bs = self.block_size
+        toks = [int(t) for t in tokens]
+        node = self._root
+        chain: List[_Node] = []
+        pos = 0
+        while len(toks) - pos >= bs:
+            child = node.children.get(tuple(toks[pos: pos + bs]))
+            if child is None:
+                break
+            chain.append(child)
+            pos += bs
+            node = child
+        rem = toks[pos:]
+        best, best_node = 0, None
+        if rem:
+            for child in list(node.children.values()) + node.partials:
+                if child.tokens[0] != rem[0]:
+                    continue
+                l = _lcp(child.tokens, rem)
+                if l > best:
+                    best, best_node = l, child
+        if best:
+            chain.append(best_node)
+            pos += best
+        return pos, chain
 
     # ------------------------------------------------------------------
     def insert(self, tokens, blocks: Sequence[int]
@@ -149,7 +212,18 @@ class PrefixIndex:
             seg = tuple(toks[i * bs: (i + 1) * bs])
             if len(seg) == bs:
                 child = node.children.get(seg)
-                if child is not None:  # already cached; ours is surplus
+                if child is not None:
+                    if child.host_key is not None:
+                        # HOST-resident under identical tokens and the
+                        # retiree holds the SAME rows on device: rebind
+                        # the node to the device block (a free
+                        # promotion — no upload) and drop the host copy
+                        hk = child.host_key
+                        self.promote(child, block)
+                        if self.host_drop is not None:
+                            self.host_drop(hk)
+                        newly_cached.append(block)
+                    # else: already device-cached; ours is surplus
                     node = child
                     continue
                 # a partial leaf our full block extends: upgrade it in
@@ -162,7 +236,14 @@ class PrefixIndex:
                         break
                 if upgraded is not None:
                     node.partials.remove(upgraded)
-                    if upgraded.block != block:
+                    if upgraded.host_key is not None:
+                        # the host partial's payload is superseded by
+                        # the full device block upgrading it
+                        hk = upgraded.host_key
+                        upgraded.host_key = None
+                        if self.host_drop is not None:
+                            self.host_drop(hk)
+                    elif upgraded.block != block:
                         displaced.append(upgraded.block)
                         self._by_block.pop(upgraded.block, None)
                     upgraded.tokens = seg
@@ -199,7 +280,14 @@ class PrefixIndex:
                 if covered is not None:
                     break  # existing leaf already holds (at least) ours
                 if extended is not None:
-                    if extended.block != block:
+                    if extended.host_key is not None:
+                        # upgrading a HOST partial leaf: the device
+                        # block supersedes the (shorter) host payload
+                        hk = extended.host_key
+                        extended.host_key = None
+                        if self.host_drop is not None:
+                            self.host_drop(hk)
+                    elif extended.block != block:
                         displaced.append(extended.block)
                         self._by_block.pop(extended.block, None)
                     extended.tokens = seg
@@ -214,25 +302,68 @@ class PrefixIndex:
         return newly_cached, displaced
 
     # ------------------------------------------------------------------
-    def evict(self, block: int) -> List[int]:
-        """Detach the node holding ``block`` plus its whole subtree;
-        returns every block id released.  Called by the allocator's
-        reserve when the free list alone cannot fund a reservation —
-        cache memory is exactly the HBM admission doesn't need."""
-        node = self._by_block.get(block)
-        if node is None:
-            return []
+    def node_of(self, block: int) -> Optional[_Node]:
+        """The node holding DEVICE block ``block`` (None when the
+        block is not cached) — the tiering engine's entry point into
+        the eviction callback's subtree walk."""
+        return self._by_block.get(block)
+
+    def demote(self, block: int, host_key: int) -> _Node:
+        """Mark the node holding ``block`` HOST-resident: the device
+        block is released (caller returns it to the allocator) and the
+        node now points at a :class:`~kubeshare_tpu.serving.kv_tier.
+        HostTier` entry — still matchable through
+        :meth:`match_tiered`, still structurally in the trie."""
+        node = self._by_block.pop(block)
+        node.block = -1
+        node.host_key = host_key
+        return node
+
+    def promote(self, node: _Node, block: int) -> None:
+        """Re-device a HOST-resident node: its payload was uploaded
+        into pool block ``block`` (or a retiree re-materialized the
+        same tokens there)."""
+        node.host_key = None
+        node.block = block
+        self._by_block[block] = node
+
+    def detach(self, node: _Node) -> Tuple[List[int], List[int]]:
+        """Unlink ``node`` and its whole subtree from the trie;
+        returns (device_blocks, host_keys) released — the caller owns
+        returning the blocks to the allocator and forgetting the host
+        entries.  A host node's subtree is all-host (see module
+        docstring), so detaching one never releases device blocks."""
         parent = node.parent
         if len(node.tokens) == self.block_size:
             del parent.children[node.tokens]
         else:
             parent.partials.remove(node)
-        removed: List[int] = []
+        device: List[int] = []
+        host_keys: List[int] = []
         stack = [node]
         while stack:
             n = stack.pop()
-            removed.append(n.block)
-            self._by_block.pop(n.block, None)
+            if n.host_key is not None:
+                host_keys.append(n.host_key)
+            else:
+                device.append(n.block)
+                self._by_block.pop(n.block, None)
             stack.extend(n.children.values())
             stack.extend(n.partials)
-        return removed
+        return device, host_keys
+
+    def evict(self, block: int) -> List[int]:
+        """Detach the node holding ``block`` plus its whole subtree;
+        returns every DEVICE block id released (host-resident
+        descendants are purged through ``host_drop``).  Called by the
+        allocator's reserve when the free list alone cannot fund a
+        reservation — cache memory is exactly the HBM admission
+        doesn't need."""
+        node = self._by_block.get(block)
+        if node is None:
+            return []
+        device, host_keys = self.detach(node)
+        if self.host_drop is not None:
+            for hk in host_keys:
+                self.host_drop(hk)
+        return device

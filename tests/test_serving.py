@@ -1454,6 +1454,434 @@ class TestMixedBatching:
         assert guard.total_gated_ms > 0.0  # ...and charged wall time
 
 
+class TestKVTier:
+    """KV cache tiering (serving/kv_tier.py): demoted blocks round-trip
+    the wire format bit-identically, tier-on streams are bit-exact with
+    tier-off across attention variants and sampling, the tenant quota
+    ledger uncharges on demotion / re-charges on promotion, the
+    QoS-aware policy protects Guarantee host bytes, and nothing
+    recompiles after warmup (promotion is one warmed upload shape)."""
+
+    # the demote-then-promote driver sequence: r0 seeds the cache, two
+    # flushers (29 tokens -> 8 blocks each on a 12-block pool) drain it
+    # through the tier, "hit" re-matches r0's prefix from host RAM
+    def _tier_reqs(self, rng, shared):
+        return [
+            dict(rid="r0", prompt=shared, max_new_tokens=3),
+            dict(rid="f1", prompt=rng.integers(0, 64, 29),
+                 max_new_tokens=3),
+            dict(rid="f2", prompt=rng.integers(0, 64, 29),
+                 max_new_tokens=3),
+            dict(rid="hit", prompt=np.concatenate(
+                [shared, rng.integers(0, 64, 4)]), max_new_tokens=3),
+        ]
+
+    def _run_sequentially(self, engine, reqs):
+        from kubeshare_tpu.serving import Request
+
+        out = {}
+        for req in reqs:
+            engine.submit(Request(**req))
+            out.update({rid: r.tokens for rid, r in engine.run().items()
+                        if r.done})
+            engine.pop_finished()
+        return out
+
+    def _tier_engine(self, params, config, registry=None, **over):
+        from kubeshare_tpu.serving import EngineConfig, ServingEngine
+
+        kwargs = dict(num_slots=1, block_size=4, num_blocks=13,
+                      max_request_len=32, prefill_chunk=8,
+                      host_tier_bytes=1 << 20)
+        kwargs.update(over)
+        return ServingEngine(params, config, EngineConfig(**kwargs),
+                             tenants=registry)
+
+    def test_wire_roundtrip_bit_identical(self):
+        """The wire-format layer: pack -> unpack -> pack is the
+        identity, bit for bit, and foreign bytes are rejected loudly —
+        the contract a cross-slice shipper will inherit."""
+        from kubeshare_tpu.serving import (KV_WIRE_VERSION, pack_block,
+                                           unpack_block,
+                                           wire_block_bytes)
+
+        rng = np.random.default_rng(0)
+        k = rng.standard_normal((2, 2, 4, 8)).astype(np.float32)
+        v = rng.standard_normal((2, 2, 4, 8)).astype(np.float32)
+        toks = np.asarray([5, 9, 2], np.int32)  # partial block (3 < 4)
+        buf = pack_block(toks, k, v)
+        assert len(buf) == wire_block_bytes(3, 2, 2, 4, 8, 4)
+        t2, k2, v2 = unpack_block(buf)
+        assert np.array_equal(t2, toks) and t2.dtype == np.int32
+        assert np.array_equal(k2, k) and k2.dtype == k.dtype
+        assert np.array_equal(v2, v)
+        assert pack_block(t2, k2, v2) == buf  # the identity, re-packed
+        assert KV_WIRE_VERSION == 1
+        # bfloat16 — the model's flagship dtype — must round-trip too:
+        # numpy's .str tag for it is an opaque void ('<V2'), so the
+        # format carries the dtype NAME (review regression: promotion
+        # crashed on jnp.asarray of a void-dtype slab)
+        kb = k.astype(jnp.bfloat16)
+        tb, kb2, vb2 = unpack_block(pack_block(toks, np.asarray(kb),
+                                               np.asarray(kb)))
+        assert kb2.dtype == np.asarray(kb).dtype
+        assert np.array_equal(kb2.view(np.uint16),
+                              np.asarray(kb).view(np.uint16))
+        assert jnp.asarray(kb2).dtype == jnp.bfloat16  # promotion path
+        with pytest.raises(ValueError, match="magic"):
+            unpack_block(b"XXXX" + buf[4:])
+        with pytest.raises(ValueError, match="version"):
+            unpack_block(buf[:4] + b"\x63\x00" + buf[6:])
+        with pytest.raises(ValueError, match="truncated"):
+            unpack_block(buf[:10])
+
+    def test_demote_promote_roundtrip_is_byte_identical(self):
+        """Device rows -> host payload -> device rows, bit for bit:
+        capture a cached chain's K/V slabs, flush it through the tier,
+        verify the host payloads equal the captured slabs, re-admit the
+        prefix and verify the promoted blocks' device rows equal them
+        too."""
+        from kubeshare_tpu.serving import Request, unpack_block
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        engine = self._tier_engine(params, config)
+        rng = np.random.default_rng(7)
+        shared = rng.integers(0, 64, 13)
+        engine.submit(Request("r0", shared, 3))
+        engine.run()
+        matched, blocks = engine.prefix_index.match(shared)
+        assert matched == 13 and len(blocks) == 4  # 3 full + partial
+        slabs = [(np.asarray(engine.pool.k[:, b]),
+                  np.asarray(engine.pool.v[:, b])) for b in blocks[:3]]
+        for rid in ("f1", "f2"):  # flush the cache through the tier
+            engine.submit(Request(rid, rng.integers(0, 64, 29), 3))
+            engine.run()
+        assert engine.tier_demoted_blocks > 0
+        matched, chain = engine.prefix_index.match_tiered(shared)
+        assert matched == 13
+        host_nodes = [n for n in chain[:3] if n.location == "host"]
+        assert len(host_nodes) == 3  # the whole chain spilled
+        for node, (k_slab, v_slab) in zip(chain[:3], slabs):
+            _, hk, hv = unpack_block(
+                engine.host_tier.peek(node.host_key).payload)
+            assert np.array_equal(hk, k_slab)  # wire == device rows
+            assert np.array_equal(hv, v_slab)
+        engine.submit(Request("hit", shared.copy(), 3))
+        engine.run()
+        assert engine.tier_promoted_blocks >= 3
+        matched, blocks = engine.prefix_index.match(shared)
+        assert matched >= 12  # device-resident again
+        for b, (k_slab, v_slab) in zip(blocks[:3], slabs):
+            assert np.array_equal(np.asarray(engine.pool.k[:, b]), k_slab)
+            assert np.array_equal(np.asarray(engine.pool.v[:, b]), v_slab)
+
+    def test_streams_bit_exact_with_tier_across_configs(self):
+        """Tier on vs tier off, token for token, through forced
+        demote -> promote cycles — GQA, windowed, and MoE attention."""
+        cases = {
+            "gqa_rope": dict(n_kv_heads=2, positional="rope"),
+            "windowed": dict(attention_window=6),
+            "moe": dict(moe_every=2, moe_num_experts=4, moe_top_k=2),
+        }
+        rng = np.random.default_rng(11)
+        shared = rng.integers(0, 64, 13)
+        reqs = self._tier_reqs(rng, shared)
+        for name, extra in cases.items():
+            config = _small_config(**extra)
+            params = transformer_init(jax.random.PRNGKey(0), config)
+            tiered = self._tier_engine(params, config)
+            plain = self._tier_engine(params, config,
+                                      host_tier_bytes=None)
+            got = self._run_sequentially(tiered, reqs)
+            want = self._run_sequentially(plain, reqs)
+            assert got == want, name
+            assert tiered.tier_demoted_blocks > 0, name
+            assert tiered.tier_promoted_blocks > 0, name
+            assert tiered.tier_hit_requests > 0, name
+            assert plain.tier_demoted_blocks == 0
+
+    def test_sampled_streams_bit_exact_with_tier(self):
+        """The key schedule survives a host-tier hit: sampled requests
+        through demote/promote emit exactly the tier-off streams."""
+        config = _small_config(n_kv_heads=2, positional="rope")
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        rng = np.random.default_rng(13)
+        shared = rng.integers(0, 64, 13)
+        reqs = []
+        for i, req in enumerate(self._tier_reqs(rng, shared)):
+            req.update(temperature=0.8, rng=jax.random.PRNGKey(40 + i))
+            reqs.append(req)
+        tiered = self._tier_engine(params, config, top_k=10)
+        plain = self._tier_engine(params, config, top_k=10,
+                                  host_tier_bytes=None)
+        got = self._run_sequentially(tiered, reqs)
+        want = self._run_sequentially(plain, reqs)
+        assert got == want
+        assert tiered.tier_promoted_blocks > 0
+
+    def test_cow_divergence_on_promoted_block(self):
+        """A prompt diverging mid-block INSIDE a promoted block takes
+        the standard CoW path (the promoted block is shared state) and
+        still emits its solo reference stream."""
+        from kubeshare_tpu.models.decoding import greedy_decode
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        engine = self._tier_engine(params, config)
+        rng = np.random.default_rng(17)
+        shared = rng.integers(0, 64, 13)
+        diverge = np.concatenate([shared, rng.integers(0, 64, 4)])
+        diverge[9] = (diverge[9] + 1) % 64  # inside the 3rd block
+        reqs = self._tier_reqs(rng, shared) + [
+            dict(rid="cow", prompt=diverge, max_new_tokens=4)]
+        got = self._run_sequentially(engine, reqs)
+        assert engine.tier_promoted_blocks >= 3   # "hit" promoted
+        assert engine.cow_copies >= 1             # "cow" diverged on it
+        ref = np.asarray(greedy_decode(
+            params, config, jnp.asarray(diverge, jnp.int32)[None], 4))[0]
+        assert got["cow"] == list(ref)
+
+    def test_qos_policy_protects_guarantee_host_bytes(self):
+        """The tenant-aware policy's asymmetry, at the store level:
+        Guarantee pressure evicts Opportunistic entries first (even
+        when a Guarantee entry is colder), and Opportunistic pressure
+        that could only fit by evicting Guarantee bytes is REFUSED —
+        the incoming block drops instead."""
+        from kubeshare_tpu.serving import (QOS_OPPORTUNISTIC, HostTier,
+                                           QoSTierPolicy, TenantRegistry,
+                                           TenantSpec)
+
+        registry = TenantRegistry([
+            TenantSpec("gold"),
+            TenantSpec("batch", qos_class=QOS_OPPORTUNISTIC)])
+        tier = HostTier(3 * 100, QoSTierPolicy(registry))
+        pay = b"x" * 100
+        g_old = tier.put(pay, "gold", None)   # coldest entry
+        b_mid = tier.put(pay, "batch", None)
+        g_new = tier.put(pay, "gold", None)
+        assert len(tier) == 3  # budget exactly full
+        # Guarantee incoming: the batch entry goes, NOT the colder gold
+        g_more = tier.put(pay, "gold", None)
+        assert g_more is not None
+        keys = {e.key for _, e in tier.iter_lru()}
+        assert b_mid not in keys and g_old in keys and g_new in keys
+        assert tier.evicted_blocks == 1
+        # Opportunistic incoming vs an all-Guarantee store: refused
+        assert tier.put(pay, "batch", None) is None
+        assert tier.refused_blocks == 1
+        assert len(tier) == 3 and g_more in {
+            e.key for _, e in tier.iter_lru()}
+
+    def test_guarantee_demotion_evicts_opportunistic_host_blocks(self):
+        """Engine-level class asymmetry: with the qos tier policy and a
+        host budget already holding Guarantee entries, an Opportunistic
+        tenant's spills are dropped (the Guarantee prefix survives) and
+        the Guarantee tenant's later re-admission promotes from host."""
+        from kubeshare_tpu.models.decoding import greedy_decode
+        from kubeshare_tpu.serving import (QOS_OPPORTUNISTIC, Request,
+                                           TenantRegistry, TenantSpec,
+                                           wire_block_bytes)
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        registry = TenantRegistry([
+            TenantSpec("gold"),
+            TenantSpec("batch", qos_class=QOS_OPPORTUNISTIC)])
+        full_wire = wire_block_bytes(4, config.n_layers, config.kv_heads,
+                                     4, config.head_dim, 4)
+        engine = self._tier_engine(
+            params, config, registry=registry, tier_policy="qos",
+            host_tier_bytes=4 * full_wire + 200)
+        rng = np.random.default_rng(23)
+        shared = rng.integers(0, 64, 13)
+        engine.submit(Request("g0", shared, 3, tenant="gold"))
+        engine.run()
+        # batch flushers: gold's chain demotes (charged to gold), then
+        # batch's own spills must NOT evict it — they drop
+        for i, rid in enumerate(("b1", "b2")):
+            engine.submit(Request(rid, rng.integers(0, 64, 29), 3,
+                                  tenant="batch"))
+            engine.run()
+        assert engine.tier_demoted_blocks > 0
+        assert engine.tier_dropped_blocks > 0  # batch spills refused
+        tenants_left = {e.tenant for _, e in engine.host_tier.iter_lru()}
+        assert tenants_left == {"gold"}  # Guarantee bytes survived
+        hit = np.concatenate([shared, rng.integers(0, 64, 4)])
+        engine.submit(Request("ghit", hit, 3, tenant="gold"))
+        out = engine.run()
+        assert engine.tier_promoted_blocks > 0
+        ref = np.asarray(greedy_decode(
+            params, config, jnp.asarray(hit, jnp.int32)[None], 3))[0]
+        assert out["ghit"].tokens == list(ref)
+
+    def test_demotion_uncharges_quota_promotion_recharges(self):
+        """The quota-honesty satellite, regression-locked: a tenant
+        whose idle cache was DEMOTED stops being charged for it (a
+        quota-sized request then admits), and promotion re-charges the
+        blocks through the normal reservation."""
+        from kubeshare_tpu.models.decoding import greedy_decode
+        from kubeshare_tpu.serving import Request, TenantRegistry, TenantSpec
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        registry = TenantRegistry([
+            TenantSpec("t", kv_block_quota=6), TenantSpec("u")])
+        engine = self._tier_engine(params, config, registry=registry)
+        rng = np.random.default_rng(29)
+        shared = rng.integers(0, 64, 13)
+        engine.submit(Request("a", shared, 3, tenant="t"))
+        engine.run()
+        assert engine.allocator.tenant_usage("t") == 4  # idle, charged
+        for rid in ("u1", "u2"):  # u's traffic demotes t's cache
+            engine.submit(Request(rid, rng.integers(0, 64, 29), 3,
+                                  tenant="u"))
+            engine.run()
+        assert engine.tier_demoted_blocks > 0
+        assert engine.allocator.tenant_usage("t") == 0  # uncharged
+        # quota-sized request admits cleanly (17 + 7 = 24 rows = 6
+        # blocks = the whole quota — impossible if the demoted cache
+        # still occupied the ledger)
+        p_big = rng.integers(0, 64, 17)
+        engine.submit(Request("b", p_big, 7, tenant="t"))
+        out = engine.run()
+        ref = np.asarray(greedy_decode(
+            params, config, jnp.asarray(p_big, jnp.int32)[None], 7))[0]
+        assert out["b"].tokens == list(ref)
+        # promotion re-charges: t's host-resident prefix comes back as
+        # a normal charged reservation
+        engine.submit(Request("a2", np.concatenate(
+            [shared, rng.integers(0, 64, 4)]), 3, tenant="t"))
+        out = engine.run()
+        assert engine.tier_promoted_blocks > 0
+        assert engine.allocator.tenant_usage("t") >= 3
+        assert engine.allocator.tenant_usage("t") <= 6  # quota held
+
+    def test_eviction_reason_metrics(self):
+        """The eviction family's `reason` label: reservation pressure
+        and quota drain when tiering is off, tier_demote / tier_drop
+        when the tier is consulted — all four series always present."""
+        from kubeshare_tpu.serving import Request, TenantRegistry, TenantSpec
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        rng = np.random.default_rng(31)
+        # tiering OFF: a quota own-drain, then reservation pressure
+        registry = TenantRegistry([
+            TenantSpec("t", kv_block_quota=6), TenantSpec("u")])
+        plain = self._tier_engine(params, config, registry=registry,
+                                  host_tier_bytes=None)
+        plain.submit(Request("a", rng.integers(0, 64, 13), 3, tenant="t"))
+        plain.run()
+        plain.submit(Request("b", rng.integers(0, 64, 17), 7, tenant="t"))
+        plain.run()  # 4 cached + 6 needed > 6 -> own-cache quota drain
+        assert plain.evictions_by_reason["quota_drain"] > 0
+        plain.submit(Request("c", rng.integers(0, 64, 29), 3, tenant="u"))
+        plain.run()
+        assert plain.evictions_by_reason["reservation_pressure"] > 0
+        assert plain.evictions_by_reason["tier_demote"] == 0
+        families = {f.name: f for f in plain.collect_metrics()}
+        fam = families["kubeshare_serving_prefix_evicted_blocks_total"]
+        reasons = {s.labels["reason"] for s in fam.samples}
+        assert reasons == {"reservation_pressure", "quota_drain",
+                           "tier_demote", "tier_drop"}
+        total = sum(s.value for s in fam.samples)
+        assert total == plain.allocator.evicted_blocks
+        # tiering ON: the same pressure reads tier_demote (and
+        # tier_drop once the host budget refuses)
+        tiered = self._tier_engine(params, config)
+        shared = rng.integers(0, 64, 13)
+        for req in self._tier_reqs(rng, shared):
+            tiered.submit(Request(**req))
+            tiered.run()
+        assert tiered.evictions_by_reason["tier_demote"] > 0
+        assert tiered.evictions_by_reason["reservation_pressure"] == 0
+
+    def test_host_budget_lru_eviction_and_pinning(self):
+        """The store's budget discipline: LRU eviction keeps
+        used_bytes under budget, pinned entries are never victims, and
+        an all-pinned store refuses the incoming block."""
+        from kubeshare_tpu.serving import HostTier, LRUTierPolicy
+
+        tier = HostTier(2 * 100, LRUTierPolicy())
+        pay = b"x" * 100
+        k1 = tier.put(pay, None, None)
+        k2 = tier.put(pay, None, None)
+        k3 = tier.put(pay, None, None)  # evicts k1 (coldest)
+        keys = {e.key for _, e in tier.iter_lru()}
+        assert keys == {k2, k3} and tier.used_bytes == 200
+        assert tier.evicted_blocks == 1
+        tier.pin(k2)
+        k4 = tier.put(pay, None, None)  # k2 pinned -> k3 goes
+        assert {e.key for _, e in tier.iter_lru()} == {k2, k4}
+        tier.pin(k4)
+        assert tier.put(pay, None, None) is None  # all pinned: refused
+        assert tier.refused_blocks == 1
+        tier.unpin(k2)
+        assert tier.put(pay, None, None) is not None
+        # oversized payloads can never fit and are refused up front
+        assert tier.put(b"y" * 300, None, None) is None
+
+    def test_subtree_demotion_survives_one_block_host_budget(self):
+        """Review regression: demoting a multi-block subtree under a
+        host budget too small for all of it must NOT let the tier evict
+        the just-demoted ancestor to fund its own descendants — the
+        ancestor transiently has device-resident children mid-walk, and
+        detaching it then corrupted trie/allocator state (RuntimeError
+        under the allocator lock).  Walk-local pinning makes the
+        descendants DROP instead, and every device block still comes
+        back to the free list."""
+        from kubeshare_tpu.serving import Request, wire_block_bytes
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        full_wire = wire_block_bytes(4, config.n_layers, config.kv_heads,
+                                     4, config.head_dim, 4)
+        engine = self._tier_engine(params, config,
+                                   host_tier_bytes=full_wire)
+        rng = np.random.default_rng(41)
+        shared = rng.integers(0, 64, 13)
+        engine.submit(Request("r0", shared, 3))
+        engine.run()
+        # evict the CHAIN HEAD directly — the victim shape reserve's
+        # preferred-tenant scan produces for a mixed-charge chain (its
+        # head can be the first idle block charged to the preferred
+        # victim tenant, taking the whole subtree parent-first)
+        matched, blocks = engine.prefix_index.match(shared)
+        assert matched == 13
+        with engine.allocator._lock:
+            engine.allocator._evict_locked(blocks[0],
+                                           "reservation_pressure")
+        # head demoted (pinned through the walk), descendants dropped
+        # when the one-entry budget could not take them; nothing raised
+        assert engine.tier_demoted_blocks == 1
+        assert engine.tier_dropped_blocks == 3
+        assert len(engine.host_tier) == 1
+        survivor = next(e.key for _, e in engine.host_tier.iter_lru())
+        assert not engine.host_tier.is_pinned(survivor)  # pin released
+        # allocator conservation: every block is free or idle-cached
+        assert (engine.allocator.free_blocks
+                + engine.allocator.cached_idle_blocks
+                == engine.allocator.num_blocks - 1)
+
+    def test_zero_recompiles_with_tier_promotions(self):
+        """Acceptance criterion: warmup covers the upload shape, so a
+        workload full of demotions and promotions adds ZERO compiled
+        shapes beyond the warmed set."""
+        config = _small_config(n_kv_heads=2, positional="rope")
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        engine = self._tier_engine(params, config)
+        engine.warmup()
+        baseline = engine.compile_counts()
+        assert baseline["upload"] == 1  # the tier's single extra shape
+        rng = np.random.default_rng(37)
+        shared = rng.integers(0, 64, 13)
+        self._run_sequentially(engine, self._tier_reqs(rng, shared))
+        assert engine.tier_demoted_blocks > 0
+        assert engine.tier_promoted_blocks > 0
+        assert engine.compile_counts() == baseline
+
+
 class TestServingBenchSmoke:
     def test_smoke_ratio_and_zero_recompiles(self):
         """The bench's CPU smoke path: continuous vs run-to-completion
