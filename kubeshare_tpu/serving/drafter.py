@@ -1,0 +1,110 @@
+"""Self-drafting n-gram / prompt-lookup drafter for the serving engine.
+
+Speculative decoding needs a cheap source of proposed tokens; the
+classic recipe runs a second, smaller model.  The serving engine
+deliberately does NOT: each decode lane drafts from text it has already
+seen — the longest suffix of its own prompt + generated history is
+looked up for an earlier occurrence, and the tokens that followed that
+occurrence become the draft (prompt-lookup decoding).  Structured
+serving traces (templated prompts, retrieval contexts, code, anything
+the model partially copies or loops on) repeat n-grams constantly, and
+a draft is FREE to be wrong: every proposal is verified against the
+target model's own picks in one width-W cached dispatch
+(``paged.paged_verify_span``), so a miss costs a dispatch that emitted
+one token — exactly what a non-speculative step would have paid — while
+a hit emits the whole accepted prefix plus the correction pick.
+
+Correctness therefore never depends on anything in this file; only the
+acceptance RATE does.  That keeps the drafter deliberately dumb and
+deterministic:
+
+- lookup prefers the LONGEST matching suffix (``max_order`` down to 1)
+  and, within an order, the MOST RECENT earlier occurrence — recency
+  beats frequency on the repetitive structures that make speculation
+  pay (a loop's latest iteration predicts its next);
+- the primary window is the lane's own prompt + generated history;
+  a secondary HINT window (on cache-hit lanes: the prompt plus the
+  radix trie's cached continuation of it, ``PrefixIndex.continuation``)
+  is searched at the same order when the primary misses — a previous
+  request's generation predicts a re-run's;
+- state is a plain token list, rebuilt from ``prompt + generated`` on
+  preemption-resume (that concatenation IS the resumed request's
+  prompt, so a resumed lane drafts from the identical window an
+  unpreempted lane would — test-locked).
+
+The engine truncates every draft to ``min(adaptive width, remaining
+budget - 1)`` before proposing — drafting past what the request may
+still emit would only write dead K/V rows.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+
+class NGramDrafter:
+    """One lane's drafting state: a token history window plus the
+    suffix-lookup proposer.  Histories are bounded by the engine's
+    ``max_request_len`` (a few hundred tokens), so lookup is a plain
+    backward scan — no index to keep coherent across preemption."""
+
+    def __init__(self, max_order: int = 3,
+                 history: Optional[Sequence[int]] = None) -> None:
+        if max_order < 1:
+            raise ValueError(f"max_order must be >= 1, got {max_order}")
+        self.max_order = max_order
+        self._history: List[int] = []
+        self._hint: List[int] = []
+        if history is not None:
+            self.extend(history)
+
+    @property
+    def history(self) -> List[int]:
+        """The primary lookup window (prompt + generated so far)."""
+        return list(self._history)
+
+    def extend(self, tokens: Sequence[int]) -> None:
+        """Append emitted (verified) tokens to the lookup window."""
+        self._history.extend(int(t) for t in tokens)
+
+    def hint(self, tokens: Sequence[int]) -> None:
+        """Install the secondary lookup window — searched only when the
+        lane's own history has no occurrence of the current suffix.
+        The engine passes ``prompt + trie continuation`` here so the
+        suffix positions line up with real history positions."""
+        self._hint = [int(t) for t in tokens]
+
+    def propose(self, k: int) -> List[int]:
+        """Up to ``k`` drafted tokens continuing the current history,
+        or [] when no suffix of any order has an earlier occurrence
+        (the lane then rides the verify dispatch as a plain width-1
+        decode, or the engine falls back to the decode span).
+
+        Longest suffix wins across orders; within an order the lane's
+        own history beats the hint, and the most recent occurrence
+        beats older ones.  A history shorter than ``order + 1`` simply
+        has no earlier occurrence to find — prompts shorter than the
+        n-gram order degrade gracefully to lower orders."""
+        if k < 1:
+            return []
+        h = self._history
+        for order in range(min(self.max_order, len(h) - 1), 0, -1):
+            pattern = h[-order:]
+            found = self._find(h, pattern, k)
+            if not found:
+                found = self._find(self._hint, pattern, k)
+            if found:
+                return found
+        return []
+
+    @staticmethod
+    def _find(seq: List[int], pattern: List[int], k: int) -> List[int]:
+        """Most recent occurrence of ``pattern`` in ``seq`` that has at
+        least one following token; returns up to ``k`` followers.  The
+        scan starts at ``len - order - 1`` so the history's own current
+        suffix (which has nothing after it) is never the match."""
+        order = len(pattern)
+        for i in range(len(seq) - order - 1, -1, -1):
+            if seq[i: i + order] == pattern:
+                return seq[i + order: i + order + k]
+        return []

@@ -107,12 +107,15 @@ from ..models.decoding import _filter_logits, bucket_width
 from ..models.transformer import TransformerConfig
 from ..utils.promtext import (MetricFamily, MetricServer, Sample,
                               _format_value)
+from .drafter import NGramDrafter
 from .kv_blocks import (BlockAllocator, BlockExhausted, QuotaExceeded,
                         init_paged_pool)
 from .kv_tier import (HostTier, LRUTierPolicy, QoSTierPolicy, pack_block,
                       unpack_block, wire_block_bytes)
-from .paged import (paged_copy_block, paged_decode_span, paged_mixed_step,
-                    paged_prefill_step, paged_upload_block)
+from .paged import (paged_copy_block, paged_decode_span,
+                    paged_mixed_step, paged_mixed_verify_step,
+                    paged_prefill_step, paged_upload_block,
+                    paged_verify_span)
 from .prefix_index import PrefixIndex
 from .qos import (DEFAULT_TENANT, QOS_GUARANTEE, QOS_OPPORTUNISTIC,
                   FairQueue, TenantRegistry, TenantSpec)
@@ -127,6 +130,17 @@ TTFT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
 # a multi-chunk prompt) shows up in the 100ms..1s slots.
 TBT_BUCKETS = (0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                0.1, 0.25, 0.5, 1.0)
+# Speculative acceptance-ratio bucket bounds: per verify round,
+# accepted drafts / drafted — always in [0, 1], so the +Inf tail stays
+# structurally empty and the top bucket counts full-accept rounds.
+SPEC_ACCEPT_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+
+def _pow2_ceil(n: int) -> int:
+    """Smallest power of two >= n (n >= 1) — verify dispatch widths are
+    bucketed like prefill chunks, so ragged draft lengths hit the
+    warmed shape set instead of compiling one shape per length."""
+    return 1 << (n - 1).bit_length() if n > 1 else 1
 
 
 def _bucket_observe(counts: List[int], seconds: float,
@@ -253,6 +267,26 @@ class EngineConfig:
     # never compiles a new shape).  None = prefill_chunk (whole chunks
     # fuse, nothing is sliced).
     mixed_prefill_budget: Optional[int] = None
+    # SPECULATIVE DECODING (self-drafting, no second model): decode
+    # lanes propose up to draft_len tokens by n-gram lookup over their
+    # own prompt + generated history (serving/drafter.py) and ONE
+    # width-W verify dispatch (paged.paged_verify_span) scores every
+    # lane's proposals — the accepted prefix plus the correction pick
+    # emits per dispatch.  Verification is exact-match against the
+    # engine's own pick policy (greedy argmax / the categorical draw
+    # under that emission's PRNG key), so streams are bit-exact with
+    # speculation off BY CONSTRUCTION, greedy and sampled alike, and
+    # the per-request key schedule is consumed identically.  False is
+    # the bench's control arm.
+    speculative: bool = False
+    # max drafted tokens per lane per verify round.  Must be a power of
+    # two: the per-lane ADAPTIVE width (driven by a rolling acceptance
+    # rate) doubles/halves within {1, 2, ..., draft_len}, so warmup
+    # compiles O(log draft_len) verify shapes and nothing recompiles
+    # mid-serve.
+    draft_len: int = 4
+    # the drafter's maximum n-gram order (longest suffix looked up)
+    draft_ngram: int = 3
 
 
 @dataclass
@@ -345,12 +379,34 @@ class RequestResult:
         return self.first_token_at - self.submitted_at
 
 
+@dataclass
+class _StepPlan:
+    """ONE scheduling decision, separated from dispatch mechanics:
+    :meth:`ServingEngine._plan_step` decides which lanes prefill /
+    decode / verify this step and at what widths, and
+    :meth:`ServingEngine._dispatch_plan` only builds device arguments
+    and launches.  ``kind`` selects the dispatch — "prefill" (one
+    standalone chunk), "decode" (the plain span), "verify" (the
+    speculative draft-verify chunk), "mixed" / "mixed_verify" (the
+    fused prefill + decode-phase programs).  ``drafts`` maps slot index
+    to that lane's proposed tokens; ``verify_width`` is the dispatch
+    width W = 1 + the power-of-two-bucketed max draft length (a warmed
+    shape by construction)."""
+
+    kind: str
+    prefill_slot: Optional["_Slot"] = None
+    chunk: Optional[Tuple[int, int, int]] = None
+    decode_slots: List["_Slot"] = field(default_factory=list)
+    drafts: Dict[int, List[int]] = field(default_factory=dict)
+    verify_width: int = 0
+
+
 class _Slot:
     __slots__ = (
         "idx", "state", "rid", "blocks", "table", "length", "generated",
         "prompt", "plan", "max_new", "temperature", "first_key",
         "step_keys", "result", "tenant", "emitted_prefix",
-        "last_token_at",
+        "last_token_at", "drafter", "draft_width", "accept_rate",
     )
 
     def __init__(self, idx: int, table_width: int) -> None:
@@ -379,6 +435,15 @@ class _Slot:
         # wall time the slot's newest token became host-visible — the
         # inter-token-latency histogram's reference point
         self.last_token_at: Optional[float] = None
+        # speculative state (engine_config.speculative): the lane's
+        # n-gram drafter, its current adaptive draft width (a power of
+        # two in 1..draft_len), and the rolling acceptance-rate EMA
+        # driving the width.  Rebuilt at (re-)admission — a resumed
+        # lane's drafter window is prompt + generated, identical to the
+        # unpreempted lane's.
+        self.drafter: Optional[NGramDrafter] = None
+        self.draft_width = 0
+        self.accept_rate = 0.5
 
 
 class ServingEngine:
@@ -419,6 +484,14 @@ class ServingEngine:
             raise ValueError(
                 f"tier_policy must be 'lru' or 'qos', got "
                 f"{ec.tier_policy!r}")
+        if ec.draft_len < 1 or (ec.draft_len & (ec.draft_len - 1)):
+            raise ValueError(
+                f"draft_len must be a power of two >= 1, got "
+                f"{ec.draft_len} — the adaptive width doubles/halves "
+                f"within the warmed power-of-two verify shape set")
+        if ec.draft_ngram < 1:
+            raise ValueError(
+                f"draft_ngram must be >= 1, got {ec.draft_ngram}")
         # fail fast on a bad filter set, like the dense sampling entries
         _filter_logits(jnp.zeros((1, 2)), ec.top_k, ec.top_p)
         self.params = params
@@ -474,14 +547,27 @@ class ServingEngine:
         self._queue = FairQueue(self.tenants)
         self._results: Dict[str, RequestResult] = {}
         # counters (the bench's and the metrics endpoint's raw material):
-        # prefill_chunks / decode_steps count WORK UNITS (chunks
-        # processed, spans run — standalone or fused); mixed_steps
-        # counts fused dispatches, so standalone dispatch counts are
-        # prefill_chunks - mixed_steps and decode_steps - mixed_steps
-        # (a mixed dispatch carries exactly one of each).
+        # prefill_chunks / decode_steps / verify_steps count WORK UNITS
+        # (chunks processed, spans/verify chunks run — standalone or
+        # fused); mixed_steps / mixed_verify_steps count fused
+        # dispatches, so standalone dispatch counts are
+        # prefill_chunks - mixed_steps - mixed_verify_steps,
+        # decode_steps - mixed_steps, and
+        # verify_steps - mixed_verify_steps (a fused dispatch carries
+        # exactly one prefill chunk and one decode-phase unit).
         self.decode_steps = 0
         self.prefill_chunks = 0
         self.mixed_steps = 0
+        self.verify_steps = 0
+        self.mixed_verify_steps = 0
+        # speculation counters, per tenant: proposals scored by verify
+        # dispatches, drafts actually emitted, and the per-round
+        # acceptance-ratio histogram ([bucket counts, ratio sum] —
+        # the adaptive width controller's input, exported on the
+        # metrics plane)
+        self.spec_drafted: Dict[str, int] = {}
+        self.spec_accepted: Dict[str, int] = {}
+        self._spec_accept: Dict[str, list] = {}
         self.tokens_generated = 0
         self.peak_blocks_in_use = 0
         self.requests_admitted = 0
@@ -585,6 +671,34 @@ class ServingEngine:
                 d_budgets)
 
         self._mixed_step = jax.jit(mixed, donate_argnums=(1, 2))
+
+        def verify(w, pk, pv, tables, lengths, active, tokens, widths,
+                   temps, keys):
+            # the draft-verify chunk: every lane's self-drafted tokens
+            # scored in ONE width-W dispatch, each column picked under
+            # its own emission's temperature/PRNG key — acceptance
+            # reproduces the sequential stream exactly (bit-exact with
+            # speculation off by construction).
+            return paged_verify_span(
+                w, cfg, pick_rows, pk, pv, tables, lengths, active,
+                tokens, widths, temps, keys)
+
+        self._verify_step = jax.jit(verify, donate_argnums=(1, 2))
+
+        def mixed_verify(w, pk, pv, p_table, p_start, p_tokens,
+                         p_last_row, p_temp, p_key, d_tables, d_lengths,
+                         d_active, d_tokens, d_widths, d_temps, d_keys):
+            # the speculative fused dispatch: one bounded prefill chunk
+            # + the verify chunk, one program — same composition-over-
+            # disjoint-blocks argument as the plain mixed step, so both
+            # sides' streams are unchanged.
+            return paged_mixed_verify_step(
+                w, cfg, pick_rows, pk, pv, p_table, p_start, p_tokens,
+                p_last_row, p_temp, p_key, d_tables, d_lengths,
+                d_active, d_tokens, d_widths, d_temps, d_keys)
+
+        self._mixed_verify_step = jax.jit(mixed_verify,
+                                          donate_argnums=(1, 2))
         # the copy-on-write primitive: one block, all layers, K and V —
         # a single static shape, so the cache adds exactly ONE compile.
         # Wrapped per-engine (like prefill/decode above): jitting the
@@ -667,46 +781,117 @@ class ServingEngine:
 
     def step(self) -> bool:
         """One scheduling iteration: admit what fits, consume the
-        previous dispatch's results, then dispatch the next step.
-
-        Scheduling discipline: when prefill and decode work coexist
-        (and ``mixed`` is on, the default) ONE fused dispatch advances
-        every decode lane by its span AND consumes one budget-bounded
-        prefill chunk for one filling slot — decode lanes never wait
-        behind a prompt, and a filling slot still earns its chunk every
-        step.  With ``mixed`` off, prefill has strict priority (the
-        Orca either/or discipline — TTFT-optimal, but every prompt
-        chunk stalls every decode lane for its full duration).  Either
-        way, filling slots rotate round-robin so a many-chunk prompt
-        cannot monopolize prefill ticks over later admissions.
+        previous dispatch's results, PLAN the next step
+        (:meth:`_plan_step` — which lanes prefill / decode / verify,
+        at what widths), then dispatch the plan
+        (:meth:`_dispatch_plan` — device arguments and launch only).
 
         Pipelining: admission (pure host work — queue, allocator,
         trie) runs BEFORE the previous dispatch's results are read, so
         on an unguarded engine it overlaps device execution; the
-        emitted tokens are then consumed and the next step dispatched.
-        Returns False when the engine is fully idle."""
+        emitted tokens are then consumed (planning needs fresh lane
+        state — the drafter reads ``generated``) and the next step
+        dispatched.  Returns False when the engine is fully idle."""
         self._admit()
         consumed = self._consume_inflight()
+        plan = self._plan_step()
+        if plan is None:
+            return consumed
+        self._dispatch_plan(plan)
+        return True
+
+    def _plan_step(self) -> Optional[_StepPlan]:
+        """The scheduling decision, free of dispatch mechanics (the
+        first slice of the scheduler/dispatch split): pick this step's
+        work and its widths, returning a :class:`_StepPlan` (None =
+        nothing to do).
+
+        Discipline: when prefill and decode work coexist (and
+        ``mixed`` is on, the default) ONE fused dispatch advances
+        every decode lane AND consumes one budget-bounded prefill
+        chunk — decode lanes never wait behind a prompt.  With
+        ``mixed`` off, prefill has strict priority (the Orca either/or
+        discipline — TTFT-optimal, but every prompt chunk stalls every
+        decode lane for its full duration).  Either way, filling slots
+        rotate round-robin so a many-chunk prompt cannot monopolize
+        prefill ticks.  The decode phase itself has two variants
+        (:meth:`_plan_decode_phase`): the plain span, or — speculative
+        mode, when any lane drafted — one verify chunk."""
         prefill = [s for s in self._slots if s.state == "prefill"]
         decode = [s for s in self._slots if s.state == "decode"]
-        if prefill and decode and self.engine_config.mixed:
+        ec = self.engine_config
+        if prefill and decode and ec.mixed:
             slot = self._next_prefill_slot(prefill)
             chunk = self._sliced_chunk(slot)
-            if chunk[1] <= self._mixed_budget:
-                self._run_mixed_step(decode, slot, chunk)
-            else:
+            if chunk[1] > self._mixed_budget:
                 # an unsliceable pad-forward tail over the budget (its
                 # logits row sits inside the chunk): the one shape that
                 # still stalls decode, for a single bounded dispatch
-                self._run_prefill_chunk(slot, chunk)
-            return True
+                return _StepPlan("prefill", prefill_slot=slot,
+                                 chunk=chunk)
+            plan = self._plan_decode_phase(decode)
+            plan.kind = ("mixed_verify" if plan.kind == "verify"
+                         else "mixed")
+            plan.prefill_slot, plan.chunk = slot, chunk
+            return plan
         if prefill:
-            self._run_prefill_chunk(self._next_prefill_slot(prefill))
-            return True
+            slot = self._next_prefill_slot(prefill)
+            return _StepPlan("prefill", prefill_slot=slot,
+                             chunk=slot.plan.pop(0))
         if decode:
-            self._run_decode_step(decode)
-            return True
-        return consumed
+            return self._plan_decode_phase(decode)
+        return None
+
+    def _plan_decode_phase(self, decode: List[_Slot]) -> _StepPlan:
+        """Decode-phase variant selection.  Speculative mode: lanes
+        whose drafter found a continuation ride ONE verify chunk;
+        lanes without a draft ride along at width 1 (for them the
+        chunk IS a decode step — one pick, one emission).  When nobody
+        drafted, the plain decode span is strictly better (it emits up
+        to ``decode_span`` per dispatch), so the plan falls back to
+        it."""
+        ec = self.engine_config
+        if ec.speculative:
+            drafts = self._plan_drafts(decode)
+            if drafts:
+                width = 1 + _pow2_ceil(
+                    max(len(d) for d in drafts.values()))
+                return _StepPlan("verify", decode_slots=decode,
+                                 drafts=drafts, verify_width=width)
+        return _StepPlan("decode", decode_slots=decode)
+
+    def _plan_drafts(self, decode: List[_Slot]) -> Dict[int, List[int]]:
+        """Each decode lane's proposal for this step, truncated to
+        ``min(adaptive width, remaining budget - 1)`` — a verify round
+        emits at most k + 1 tokens (accepted prefix + correction
+        pick), so a draft wider than remaining - 1 could only write
+        dead K/V rows past what the request may emit."""
+        drafts: Dict[int, List[int]] = {}
+        for slot in decode:
+            rem = slot.max_new - len(slot.generated)
+            k = min(slot.draft_width, rem - 1)
+            if k < 1:
+                continue
+            prop = slot.drafter.propose(k)
+            if prop:
+                drafts[slot.idx] = prop
+        return drafts
+
+    def _dispatch_plan(self, plan: _StepPlan) -> None:
+        """Launch one planned step — device-argument marshaling and
+        dispatch only; every scheduling decision was made in
+        :meth:`_plan_step`."""
+        if plan.kind == "mixed":
+            self._run_mixed_step(plan.decode_slots, plan.prefill_slot,
+                                 plan.chunk)
+        elif plan.kind == "mixed_verify":
+            self._run_mixed_verify_step(plan)
+        elif plan.kind == "prefill":
+            self._run_prefill_chunk(plan.prefill_slot, plan.chunk)
+        elif plan.kind == "verify":
+            self._run_verify_step(plan)
+        else:
+            self._run_decode_step(plan.decode_slots)
 
     def run(self) -> Dict[str, RequestResult]:
         """Drain the queue and every in-flight slot; returns results by
@@ -738,14 +923,26 @@ class ServingEngine:
             del self._results[rid]
         return done
 
+    def _verify_ks(self) -> List[int]:
+        """Every draft width the adaptive controller can reach: powers
+        of two from 1 up to ``draft_len`` (the verify dispatch is then
+        width ``1 + k``)."""
+        ks, k = [], 1
+        while k <= self.engine_config.draft_len:
+            ks.append(k)
+            k *= 2
+        return ks
+
     def warmup(self) -> None:
         """Compile every step the engine can ever dispatch: the decode
         step, one prefill chunk per bucketed width, and (mixed
         batching on) one MIXED shape per bucketed width — a sliced
         fused chunk is always a power-of-two piece at or under the
-        budget, so the same bucket set covers it.  After this, a
-        workload of any shape runs with ZERO recompilation
-        (compile_counts stays fixed — test- and bench-asserted)."""
+        budget, so the same bucket set covers it.  Speculative mode
+        adds one VERIFY shape per reachable draft width (and the fused
+        mixed-verify cross product).  After this, a workload of any
+        shape runs with ZERO recompilation (compile_counts stays fixed
+        — test- and bench-asserted)."""
         ec = self.engine_config
         widths = {ec.prefill_chunk}
         w = 1
@@ -787,6 +984,23 @@ class ServingEngine:
                     jnp.zeros((s, ec.decode_span, 2), jnp.uint32),
                     zeros_s)
                 self.pool = replace(self.pool, k=pk, v=pv)
+                if ec.speculative:
+                    # every (prefill bucket) x (verify width) fused
+                    # shape the speculative scheduler can reach
+                    for k in self._verify_ks():
+                        _, _, _, pk, pv = self._mixed_verify_step(
+                            self.params, self.pool.k, self.pool.v,
+                            jnp.zeros((1, self._table_width), jnp.int32),
+                            one, jnp.zeros((1, width), jnp.int32), one,
+                            jnp.zeros((1,), jnp.float32),
+                            jnp.zeros((1, 2), jnp.uint32),
+                            jnp.zeros((s, self._table_width), jnp.int32),
+                            zeros_s, jnp.zeros((s,), bool),
+                            jnp.full((s, 1 + k), -1, jnp.int32),
+                            jnp.ones((s,), jnp.int32),
+                            jnp.zeros((s,), jnp.float32),
+                            jnp.zeros((s, 1 + k, 2), jnp.uint32))
+                        self.pool = replace(self.pool, k=pk, v=pv)
         _, pk, pv = self._decode_step(
             self.params, self.pool.k, self.pool.v,
             jnp.zeros((s, self._table_width), jnp.int32),
@@ -794,6 +1008,20 @@ class ServingEngine:
             jnp.zeros((s,), jnp.float32),
             jnp.zeros((s, ec.decode_span, 2), jnp.uint32), zeros_s)
         self.pool = replace(self.pool, k=pk, v=pv)
+        if ec.speculative:
+            # verify widths are 1 + pow2(max draft) with the adaptive
+            # controller confined to power-of-two widths <= draft_len,
+            # so this small set is exhaustive
+            for k in self._verify_ks():
+                _, _, pk, pv = self._verify_step(
+                    self.params, self.pool.k, self.pool.v,
+                    jnp.zeros((s, self._table_width), jnp.int32),
+                    zeros_s, jnp.zeros((s,), bool),
+                    jnp.full((s, 1 + k), -1, jnp.int32),
+                    jnp.ones((s,), jnp.int32),
+                    jnp.zeros((s,), jnp.float32),
+                    jnp.zeros((s, 1 + k, 2), jnp.uint32))
+                self.pool = replace(self.pool, k=pk, v=pv)
         if self.prefix_index is not None:
             # the CoW copy's one shape; scratch -> scratch is a no-op
             zero = jnp.zeros((), jnp.int32)
@@ -818,6 +1046,8 @@ class ServingEngine:
             "decode": self._decode_step._cache_size(),
             "prefill": self._prefill_step._cache_size(),
             "mixed": self._mixed_step._cache_size(),
+            "verify": self._verify_step._cache_size(),
+            "mixed_verify": self._mixed_verify_step._cache_size(),
             "copy": self._copy_step._cache_size(),
             "upload": self._upload_step._cache_size(),
         }
@@ -850,13 +1080,18 @@ class ServingEngine:
         dispatches = MetricFamily(
             "kubeshare_serving_dispatches_total",
             "Device dispatches by kind (mixed = one fused prefill "
-            "chunk + decode span; the standalone kinds exclude fused "
-            "work).", "counter")
+            "chunk + decode span, mixed_verify = prefill chunk + "
+            "verify chunk; the standalone kinds exclude fused work).",
+            "counter")
         dispatches.add({"kind": "prefill_chunk"},
-                       self.prefill_chunks - self.mixed_steps)
+                       self.prefill_chunks - self.mixed_steps
+                       - self.mixed_verify_steps)
         dispatches.add({"kind": "decode_span"},
                        self.decode_steps - self.mixed_steps)
         dispatches.add({"kind": "mixed"}, self.mixed_steps)
+        dispatches.add({"kind": "verify_span"},
+                       self.verify_steps - self.mixed_verify_steps)
+        dispatches.add({"kind": "mixed_verify"}, self.mixed_verify_steps)
         dispatches.add({"kind": "cow_copy"}, self.cow_copies)
         prefix = MetricFamily(
             "kubeshare_serving_prefix_cache_requests_total",
@@ -966,10 +1201,31 @@ class ServingEngine:
             _histogram_samples(
                 tbt, "kubeshare_serving_tbt_seconds",
                 {"qos": cls}, counts, total, TBT_BUCKETS)
+        spec_tokens = MetricFamily(
+            "kubeshare_serving_spec_tokens_total",
+            "Speculative decoding volume per tenant: drafted = "
+            "proposal tokens scored by verify dispatches, accepted = "
+            "drafted tokens that reached the stream (the correction "
+            "pick is not counted — it is not a draft).", "counter")
+        for name in self.tenants.names():
+            spec_tokens.add({"tenant": name, "kind": "drafted"},
+                            self.spec_drafted.get(name, 0))
+            spec_tokens.add({"tenant": name, "kind": "accepted"},
+                            self.spec_accepted.get(name, 0))
+        spec_accept = MetricFamily(
+            "kubeshare_serving_spec_acceptance_ratio",
+            "Per-verify-round draft acceptance rate (accepted prefix / "
+            "drafted) by tenant — the drafter's hit quality on that "
+            "tenant's traffic, and the adaptive width controller's "
+            "input.", "histogram")
+        for name, (counts, total) in sorted(self._spec_accept.items()):
+            _histogram_samples(
+                spec_accept, "kubeshare_serving_spec_acceptance_ratio",
+                {"tenant": name}, counts, total, SPEC_ACCEPT_BUCKETS)
         return [req, blocks, tokens, dispatches, prefix, hit_tokens,
                 evicted, tier_blocks, tier_req, tier_tokens, tier_bytes,
                 tier_stall, ttft, t_depth, t_blocks, t_tokens, preempt,
-                cls_ttft, tbt]
+                cls_ttft, tbt, spec_tokens, spec_accept]
 
     def serve_metrics(self, port: int = 0) -> MetricServer:
         """Start the textfile HTTP scrape endpoint (``/metrics`` and
@@ -1384,6 +1640,29 @@ class ServingEngine:
         slot.result = self._results[pending.rid]
         if slot.result.admitted_at is None:
             slot.result.admitted_at = time.monotonic()
+        ec = self.engine_config
+        if ec.speculative:
+            # drafting state: the lane's lookup window starts as its
+            # prompt — for a resumed request that IS prompt + generated,
+            # so the rebuilt drafter sees the identical window an
+            # unpreempted lane would.  Width starts optimistic at the
+            # full draft_len — a wide verify is still ONE dispatch, so
+            # over-drafting costs compute but never dispatches, while
+            # under-drafting a loopy lane forfeits emissions; lanes
+            # whose proposals miss halve down within a few rounds of
+            # the acceptance EMA.
+            slot.drafter = NGramDrafter(ec.draft_ngram, pending.prompt)
+            slot.draft_width = ec.draft_len
+            slot.accept_rate = 0.5
+            if self.prefix_index is not None:
+                # a cache-hit lane has seen this movie: the trie's
+                # cached continuation of the prompt is a second lookup
+                # window (a previous request's generation predicts a
+                # re-run's)
+                cont = self.prefix_index.continuation(
+                    pending.prompt, 4 * ec.draft_len)
+                if cont:
+                    slot.drafter.hint(list(pending.prompt) + cont)
         self.peak_blocks_in_use = max(
             self.peak_blocks_in_use, self.allocator.blocks_in_use)
         return "admitted"
@@ -1598,7 +1877,7 @@ class ServingEngine:
         if final:
             # the fused pick at the final chunk's last-real-row logits
             # IS the first token; read when consumed (one step later)
-            self._inflight = (None, [], None, (slot, picked))
+            self._inflight = ("span", None, (slot, picked))
 
     def _run_decode_step(self, decode_slots: List[_Slot]) -> None:
         tables, lengths, active, tokens, temps, keys, budgets = \
@@ -1610,7 +1889,8 @@ class ServingEngine:
             jnp.asarray(budgets))
         self.pool = replace(self.pool, k=pk, v=pv)
         self.decode_steps += 1
-        self._inflight = (emitted, list(decode_slots), budgets, None)
+        self._inflight = ("span", (emitted, list(decode_slots), budgets),
+                          None)
 
     def _run_mixed_step(self, decode_slots: List[_Slot], p_slot: _Slot,
                         chunk: Tuple[int, int, int]) -> None:
@@ -1632,25 +1912,119 @@ class ServingEngine:
         self.decode_steps += 1
         self.mixed_steps += 1
         self._queue.charge(p_slot.tenant, chunk[1])
-        self._inflight = (emitted, list(decode_slots), budgets,
+        self._inflight = ("span", (emitted, list(decode_slots), budgets),
                           (p_slot, picked) if final else None)
+
+    def _verify_lanes(self, decode_slots: List[_Slot],
+                      drafts: Dict[int, List[int]], width: int):
+        """Device arguments for a verify chunk over the slot pool.
+        Proposal columns a lane does not fill carry ``-1`` — an
+        impossible token, so the acceptance cumprod can never count a
+        pad as a match.  Each lane's key window is the SAME
+        ``step_keys[offset : offset + width]`` slice a width-``width``
+        decode span would consume: accepted picks burn their keys at
+        the identical emission indices, and a rejected column's key is
+        simply re-consumed at the same emission number next round —
+        the schedule stays aligned with the non-speculative stream by
+        construction."""
+        s = self.engine_config.num_slots
+        tables = np.zeros((s, self._table_width), np.int32)
+        lengths = np.zeros((s,), np.int32)
+        active = np.zeros((s,), bool)
+        tokens = np.full((s, width), -1, np.int32)
+        tokens[:, 0] = 0
+        widths = np.ones((s,), np.int32)
+        temps = np.zeros((s,), np.float32)
+        keys = np.zeros((s, width, 2), np.uint32)
+        budgets = np.zeros((s,), np.int32)
+        k_lanes = np.zeros((s,), np.int32)
+        for slot in decode_slots:
+            i = slot.idx
+            tables[i] = slot.table
+            lengths[i] = slot.length
+            active[i] = True
+            tokens[i, 0] = slot.generated[-1]
+            prop = drafts.get(i, [])
+            k_lanes[i] = len(prop)
+            widths[i] = 1 + len(prop)
+            if prop:
+                tokens[i, 1: 1 + len(prop)] = prop
+            temps[i] = slot.temperature
+            budgets[i] = slot.max_new - len(slot.generated)
+            if slot.temperature > 0.0:
+                offset = len(slot.generated) - 1
+                window = slot.step_keys[offset: offset + width]
+                keys[i, : len(window)] = window
+        return (tables, lengths, active, tokens, widths, temps, keys,
+                budgets, k_lanes)
+
+    def _run_verify_step(self, plan: _StepPlan) -> None:
+        """One draft-verify chunk: every decode lane scores its
+        proposal row (width-1 lanes degenerate to a decode step) in
+        ONE cached dispatch (``paged.paged_verify_span``)."""
+        (tables, lengths, active, tokens, widths, temps, keys, budgets,
+         k_lanes) = self._verify_lanes(
+            plan.decode_slots, plan.drafts, plan.verify_width)
+        picked, accepts, pk, pv = self._dispatch(
+            self._verify_step, self.params, self.pool.k, self.pool.v,
+            jnp.asarray(tables), jnp.asarray(lengths), jnp.asarray(active),
+            jnp.asarray(tokens), jnp.asarray(widths), jnp.asarray(temps),
+            jnp.asarray(keys))
+        self.pool = replace(self.pool, k=pk, v=pv)
+        self.verify_steps += 1
+        self._inflight = ("verify",
+                          (picked, accepts, list(plan.decode_slots),
+                           k_lanes, budgets), None)
+
+    def _run_mixed_verify_step(self, plan: _StepPlan) -> None:
+        """The speculative flavor of the stall-free fused dispatch:
+        every decode lane rides one verify chunk AND the filling slot
+        consumes one budget-bounded prefill chunk, in ONE device
+        program (``paged.paged_mixed_verify_step``)."""
+        p_slot, chunk = plan.prefill_slot, plan.chunk
+        final, table, start, segment, last_row, temp, key = \
+            self._prefill_lane(p_slot, chunk)
+        (tables, lengths, active, tokens, widths, temps, keys, budgets,
+         k_lanes) = self._verify_lanes(
+            plan.decode_slots, plan.drafts, plan.verify_width)
+        picked_p, picked, accepts, pk, pv = self._dispatch(
+            self._mixed_verify_step, self.params, self.pool.k,
+            self.pool.v, table, start, segment, last_row, temp, key,
+            jnp.asarray(tables), jnp.asarray(lengths), jnp.asarray(active),
+            jnp.asarray(tokens), jnp.asarray(widths), jnp.asarray(temps),
+            jnp.asarray(keys))
+        self.pool = replace(self.pool, k=pk, v=pv)
+        self.prefill_chunks += 1
+        self.verify_steps += 1
+        self.mixed_verify_steps += 1
+        self._queue.charge(p_slot.tenant, chunk[1])
+        self._inflight = ("verify",
+                          (picked, accepts, list(plan.decode_slots),
+                           k_lanes, budgets),
+                          (p_slot, picked_p) if final else None)
 
     def _consume_inflight(self) -> bool:
         """Apply the previous dispatch's host-side effects: read its
         emitted tokens (the only device sync in the unguarded hot
         loop) and run first-token/acceptance/retirement bookkeeping.
         Runs before every new dispatch and before any scheduling
-        decision that needs fresh slot state (preemption).  Returns
-        True when there was something to consume."""
+        decision that needs fresh slot state (preemption, drafting).
+        Returns True when there was something to consume."""
         if self._inflight is None:
             return False
-        emitted, decode_slots, budgets, prefill_part = self._inflight
+        kind, decode_part, prefill_part = self._inflight
         self._inflight = None
         if prefill_part is not None:
             slot, picked = prefill_part
             self._finish_prefill(slot, int(np.asarray(picked)[0]))
-        if decode_slots:
-            self._accept_decode(decode_slots, np.asarray(emitted), budgets)
+        if decode_part is not None:
+            if kind == "verify":
+                picked, accepts, slots, k_lanes, budgets = decode_part
+                self._accept_verify(slots, np.asarray(picked),
+                                    np.asarray(accepts), k_lanes, budgets)
+            else:
+                emitted, slots, budgets = decode_part
+                self._accept_decode(slots, np.asarray(emitted), budgets)
         return True
 
     def _finish_prefill(self, slot: _Slot, first: int) -> None:
@@ -1675,6 +2049,8 @@ class ServingEngine:
         self.tenant_tokens[slot.tenant] = \
             self.tenant_tokens.get(slot.tenant, 0) + 1
         self._queue.charge(slot.tenant, 1)
+        if slot.drafter is not None:
+            slot.drafter.extend([first])
         slot.state = "decode"
         self._maybe_retire(slot, first)
 
@@ -1699,6 +2075,8 @@ class ServingEngine:
                 if ec.eos_token is not None and tok == ec.eos_token:
                     break
             if accepted:
+                if slot.drafter is not None:
+                    slot.drafter.extend(slot.generated[-accepted:])
                 self.tenant_tokens[slot.tenant] = \
                     self.tenant_tokens.get(slot.tenant, 0) + accepted
                 self._queue.charge(slot.tenant, accepted)
@@ -1706,6 +2084,67 @@ class ServingEngine:
                              if slot.last_token_at is not None else now)
                 self._observe_tbt(gap / accepted, accepted, slot.tenant)
                 slot.last_token_at = now
+            self._maybe_retire(slot, slot.generated[-1])
+
+    def _accept_verify(self, decode_slots: List[_Slot],
+                       picked: np.ndarray, accepts: np.ndarray,
+                       k_lanes: np.ndarray, budgets: np.ndarray) -> None:
+        """Host-side acceptance for one verify chunk: each lane emits
+        its accepted draft prefix plus the correction pick (the stream
+        a sequential decode would have produced, position by position),
+        truncated at its remaining budget and at EOS.  Also the one
+        place the adaptive draft width learns: an EMA of per-round
+        acceptance rate doubles the lane's width at >=0.75 and halves
+        it at <=0.25 — powers of two only, so every width the
+        controller can reach is a warmed bucket."""
+        ec = self.engine_config
+        now = time.monotonic()
+        for slot in decode_slots:
+            i = slot.idx
+            k = int(k_lanes[i])
+            # accepted proposal prefix, capped by the lane's own width
+            # (pads carry -1 and can never match, but be explicit)
+            m = min(int(accepts[i]), k)
+            # emissions: m accepted drafts + the correction/bonus pick,
+            # never past the request's remaining budget
+            emit = min(m + 1, int(budgets[i]))
+            accepted = 0
+            for t in range(emit):
+                tok = int(picked[i, t])
+                slot.length += 1
+                slot.generated.append(tok)
+                self.tokens_generated += 1
+                accepted += 1
+                if ec.eos_token is not None and tok == ec.eos_token:
+                    break
+            if accepted:
+                slot.drafter.extend(slot.generated[-accepted:])
+                self.tenant_tokens[slot.tenant] = \
+                    self.tenant_tokens.get(slot.tenant, 0) + accepted
+                self._queue.charge(slot.tenant, accepted)
+                gap = now - (slot.last_token_at
+                             if slot.last_token_at is not None else now)
+                self._observe_tbt(gap / accepted, accepted, slot.tenant)
+                slot.last_token_at = now
+            if k:
+                rate = m / k
+                slot.accept_rate = 0.5 * slot.accept_rate + 0.5 * rate
+                if slot.accept_rate >= 0.75:
+                    slot.draft_width = min(slot.draft_width * 2,
+                                           ec.draft_len)
+                elif slot.accept_rate <= 0.25:
+                    slot.draft_width = max(slot.draft_width // 2, 1)
+                tenant = slot.tenant
+                self.spec_drafted[tenant] = \
+                    self.spec_drafted.get(tenant, 0) + k
+                # EOS may cut emission short of the accepted prefix;
+                # count only drafts that actually reached the stream
+                self.spec_accepted[tenant] = \
+                    self.spec_accepted.get(tenant, 0) + min(m, accepted)
+                hist = self._spec_accept.setdefault(
+                    tenant, [[0] * (len(SPEC_ACCEPT_BUCKETS) + 1), 0.0])
+                hist[1] += rate
+                _bucket_observe(hist[0], rate, SPEC_ACCEPT_BUCKETS)
             self._maybe_retire(slot, slot.generated[-1])
 
     def _maybe_retire(self, slot: _Slot, token: int) -> None:

@@ -27,7 +27,14 @@ only the cache plumbing:
   lane's blocks are disjoint from every decode lane's writable blocks
   (shared prefix blocks are read-only to both — divergence is
   copied-on-write before any append), so fusing the phases cannot
-  change either side's values, only the number of device round-trips.
+  change either side's values, only the number of device round-trips;
+- :func:`paged_verify_span`: the speculative draft-verify dispatch —
+  one width-W chunk scores every lane's self-drafted tokens at once,
+  picks what sequential decoding would emit at each position (each
+  column under its own emission's temperature/PRNG key), and counts
+  the accepted prefix with the dense decoder's exact acceptance rule;
+- :func:`paged_mixed_verify_step`: the speculative twin of the mixed
+  dispatch (prefill chunk + verify span, one program).
 
 Equivalence with the dense cache is test-locked (tests/test_serving.py):
 greedy and sampled streams from the paged pool match ``init_kv_cache``
@@ -48,6 +55,7 @@ import jax.numpy as jnp
 from ..models.decoding import (
     _attend_cached,
     _check_moe_decodable,
+    speculative_acceptance,
 )
 from ..models.transformer import TransformerConfig, _rms_norm
 from ..ops.rope import apply_rope
@@ -323,6 +331,137 @@ def paged_decode_span(
     (pk, pv, _, _, _), emitted = jax.lax.scan(
         body, carry, jnp.arange(span))
     return emitted, pk, pv
+
+
+def paged_verify_span(
+    params,
+    config: TransformerConfig,
+    pick_fn,
+    pool_k,
+    pool_v,
+    tables,
+    lengths,
+    active,
+    tokens,
+    widths,
+    temps,
+    keys,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Score every lane's drafted tokens in ONE width-W cached chunk —
+    the speculative engine's draft-verify dispatch.
+
+    ``tokens`` [S, W] carries, per lane, its last emitted token at
+    column 0 followed by up to W-1 drafted tokens; ``widths`` [S] counts
+    each lane's REAL columns (1 + its draft length — pad columns beyond
+    that must hold -1 so they can never be accepted).  Column i sits at
+    virtual position ``lengths[s] + i``; the chunk's K/V land in the
+    lane's blocks first (pad and inactive-lane columns route to the
+    scratch block), then every column's query attends the lane's whole
+    gathered view under the per-query causal band — the identical
+    write-then-attend math as :func:`paged_prefill_step`, just with the
+    lm_head projected at EVERY column instead of one selected row.
+
+    ``picked`` [S, W] is the token SEQUENTIAL decoding would emit at
+    each position: column i's logits are picked with that emission's
+    own temperature/PRNG key (``keys[:, i]`` — the engine slices the
+    request's step-key schedule exactly as the decode span does), so
+    the accepted prefix plus the correction pick reproduces the
+    speculation-off stream bit for bit.  ``accepts`` [S] counts the
+    leading drafted tokens the picks agree with
+    (:func:`~kubeshare_tpu.models.decoding.speculative_acceptance` —
+    the same rule as the dense draft-model decoder); the emitted round
+    is ``picked[s, :accepts[s] + 1]``, host-truncated at budget/EOS.
+    Columns past the accepted prefix leave stale K/V at positions the
+    rewound host length masks out; the next dispatch overwrites them
+    before any causal band can attend (the same write-then-attend order
+    that makes CoW tails and pad rows dead).  Returns
+    (picked [S, W], accepts [S], pool_k, pool_v).
+    """
+    dtype = config.dtype
+    w = tokens.shape[1]
+    bs = pool_k.shape[3]
+    positions = lengths[:, None] + jnp.arange(w)[None, :]  # [S, W]
+    valid = active[:, None] & (jnp.arange(w)[None, :] < widths[:, None])
+    blk = jnp.take_along_axis(tables, positions // bs, axis=1)  # [S, W]
+    blk = jnp.where(valid, blk, 0)
+    off = positions % bs
+    # pad columns hold -1 (an impossible token, so acceptance can never
+    # match them); clamp the embed gather only — `tokens` itself keeps
+    # the -1 sentinel for the acceptance comparison below
+    x = params["embed"][jnp.maximum(tokens, 0)].astype(dtype)  # [S, W, d]
+    use_rope = config.positional == "rope"
+    if not use_rope:
+        x = x + params["pos_embed"][positions].astype(dtype)
+
+    new_k, new_v = [], []
+    for layer_idx, layer in enumerate(params["layers"]):
+        y = _rms_norm(x, layer["norm1"]["scale"])
+        q = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wq"].astype(dtype))
+        k = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wk"].astype(dtype))
+        v = jnp.einsum("bsd,dhk->bhsk", y, layer["attn"]["wv"].astype(dtype))
+        if use_rope:
+            q = apply_rope(q, positions)  # [S, W]: per-lane positions
+            k = apply_rope(k, positions)
+        pk = pool_k[layer_idx].at[blk, :, off, :].set(k.transpose(0, 2, 1, 3))
+        pv = pool_v[layer_idx].at[blk, :, off, :].set(v.transpose(0, 2, 1, 3))
+        new_k.append(pk)
+        new_v.append(pv)
+        view_k, view_v = _layer_views(pk, pv, tables, config)
+        o = _attend_cached(
+            q, view_k, view_v, positions, window=config.attention_window
+        ).astype(dtype)
+        x = x + jnp.einsum("bhsk,hkd->bsd", o, layer["attn"]["wo"].astype(dtype))
+        y = _rms_norm(x, layer["norm2"]["scale"])
+        x = x + _moe_or_mlp(layer, config, y)
+
+    x = _rms_norm(x, params["final_norm"]["scale"])
+    logits = (x @ params["lm_head"].astype(dtype)).astype(jnp.float32)
+    # column i's pick is emission-number-identical to a width-1 decode
+    # step at that position, so it consumes that emission's key
+    picked = jnp.stack(
+        [pick_fn(logits[:, i], temps, keys[:, i]) for i in range(w)],
+        axis=1)  # [S, W]
+    accepts = speculative_acceptance(tokens[:, 1:], picked)
+    return picked, accepts, jnp.stack(new_k), jnp.stack(new_v)
+
+
+def paged_mixed_verify_step(
+    params,
+    config: TransformerConfig,
+    pick_fn,
+    pool_k,
+    pool_v,
+    p_table,
+    p_start,
+    p_tokens,
+    p_last_row,
+    p_temp,
+    p_key,
+    d_tables,
+    d_lengths,
+    d_active,
+    d_tokens,
+    d_widths,
+    d_temps,
+    d_keys,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """The speculative twin of :func:`paged_mixed_step`: one fused
+    dispatch runs a bounded prefill chunk for ONE filling slot AND a
+    draft-verify chunk for every active decode lane.  Like the plain
+    mixed step it is a pure composition of the two standalone entry
+    points (prefill first, then the verify span) over disjoint writable
+    blocks, so both sides' values — and therefore the emitted streams —
+    are unchanged; only the dispatch count drops.  Returns
+    (p_picked [1], picked [S, W], accepts [S], pool_k, pool_v).
+    """
+    p_logits, pk, pv = paged_prefill_step(
+        params, config, pool_k, pool_v, p_table, p_start,
+        jnp.ones_like(p_start, bool), p_tokens, p_last_row)
+    p_picked = pick_fn(p_logits, p_temp, p_key)
+    picked, accepts, pk, pv = paged_verify_span(
+        params, config, pick_fn, pk, pv, d_tables, d_lengths, d_active,
+        d_tokens, d_widths, d_temps, d_keys)
+    return p_picked, picked, accepts, pk, pv
 
 
 def paged_mixed_step(

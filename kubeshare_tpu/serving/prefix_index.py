@@ -188,6 +188,52 @@ class PrefixIndex:
             pos += best
         return pos, chain
 
+    def continuation(self, tokens, limit: int) -> List[int]:
+        """Cached tokens that previously FOLLOWED ``tokens``: when the
+        whole sequence lies on one trie path, returns up to ``limit``
+        tokens of one cached continuation, descending first-child
+        chains deterministically (sorted full children, then partial
+        leaves).  Host-resident nodes participate — only token runs are
+        read here, never K/V.  The speculative engine seeds each
+        admitted lane's drafter lookup window with this
+        (serving/drafter.py): a prompt that prefix-cache-hits usually
+        re-runs a request whose continuation the trie still spells out,
+        and a wrong hint costs nothing — every draft is verified.
+        Returns [] when the sequence falls off the tree or diverges."""
+        bs = self.block_size
+        toks = [int(t) for t in tokens]
+        node = self._root
+        pos = 0
+        while len(toks) - pos >= bs:
+            child = node.children.get(tuple(toks[pos: pos + bs]))
+            if child is None:
+                return []
+            pos += bs
+            node = child
+        out: List[int] = []
+        rem = toks[pos:]
+        if rem:
+            nxt = None
+            for child in (sorted(node.children.values(),
+                                 key=lambda c: c.tokens)
+                          + node.partials):
+                if (len(child.tokens) >= len(rem)
+                        and list(child.tokens[: len(rem)]) == rem):
+                    nxt = child
+                    break
+            if nxt is None:
+                return []
+            out.extend(nxt.tokens[len(rem):])
+            node = nxt
+        while len(out) < limit:
+            kids = (sorted(node.children.values(), key=lambda c: c.tokens)
+                    + node.partials)
+            if not kids:
+                break
+            node = kids[0]
+            out.extend(node.tokens)
+        return [int(t) for t in out[:limit]]
+
     # ------------------------------------------------------------------
     def insert(self, tokens, blocks: Sequence[int]
                ) -> Tuple[List[int], List[int]]:
