@@ -84,8 +84,21 @@ Run:
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --mixed --smoke
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --tiered
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --tiered --smoke
+    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --disagg
+    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --disagg --smoke
     make serve-smoke serve-prefix-smoke serve-qos-smoke serve-mixed-smoke \
-         serve-tier-smoke
+         serve-tier-smoke serve-disagg-smoke
+
+- ``--disagg`` switches to the DISAGGREGATED PREFILL/DECODE
+  comparison: the long-prefill/steady-decode adversarial trace
+  replayed through a :class:`DisaggRouter` (separate prefill and
+  decode pools, finished prompts' KV chains migrated across on the
+  versioned wire format) vs the monolithic MIXED engine at equal
+  TOTAL KV-HBM budget — the split pools' allocatable blocks sum to
+  the monolithic pool's, asserted.  Headline: decode-pool TBT p99
+  (read through the metrics plane's ``pool``-labeled histogram) vs
+  the monolithic arm's, at parity aggregate tokens/s, ABA-bracketed,
+  with every stream hard-asserted identical across arms.
 
 - ``--tiered`` switches to the KV-TIERING comparison: a many-distinct-
   shared-prefixes trace whose prefix working set exceeds the device
@@ -263,6 +276,62 @@ def mixed_settings() -> dict:
         long_fraction=0.125, long_prompt_lo=192, long_prompt_hi=288,
         long_new_lo=8, long_new_hi=16,
         mean_interarrival_s=0.01, seed=0,
+    )
+
+
+def disagg_smoke_settings() -> dict:
+    """Seconds-fast disaggregation path (CI, tests/test_serving.py):
+    the mixed-batching smoke trace shape (short-prompt long-decode
+    streamers + every ~4th request a multi-chunk ingest prompt)
+    replayed disagg-on vs monolithic-mixed at ONE total KV-HBM budget,
+    split: 120 allocatable blocks monolithic = 48 prefill + 72 decode
+    (the decode pool keeps the bulk — it holds prompt AND generated
+    rows for every live stream; prefill only prompt covers)."""
+    return dict(
+        d_model=128, n_layers=1, n_heads=4, n_kv_heads=2, d_ff=256,
+        vocab_size=512, max_seq_len=192,
+        num_requests=18,
+        num_slots=5, block_size=8, num_blocks=121,   # 120 allocatable
+        prefill_num_slots=2, prefill_num_blocks=49,  # 48
+        decode_num_slots=5, decode_num_blocks=73,    # 72
+        max_request_len=192, prefill_chunk=16,
+        short_prompt_lo=8, short_prompt_hi=24,
+        short_new_lo=24, short_new_hi=40,
+        long_fraction=0.25, long_prompt_lo=96, long_prompt_hi=160,
+        long_new_lo=4, long_new_hi=12,
+        mean_interarrival_s=0.02, seed=0,
+    )
+
+
+def disagg_settings() -> dict:
+    """The disaggregation capture configuration (acceptance shape):
+    the full-bench model on the mixed-batching adversarial trace — one
+    in eight requests brings a 3-5-chunk ingest prompt into a pool of
+    long-decode streamers, decode_span 2 for a fine decode cadence
+    (same span both arms).
+    The monolithic-mixed arm fuses bounded prefill chunks into its
+    decode dispatches (PR 4's best case); the disagg arm removes the
+    contention instead of bounding it, so its decode-pool dispatches
+    never carry prefill rows at all.  KV budget: 120 allocatable
+    blocks monolithic = 40 prefill + 80 decode."""
+    return dict(
+        d_model=256, n_layers=4, n_heads=8, n_kv_heads=2, d_ff=1024,
+        vocab_size=4096, max_seq_len=320,
+        num_requests=96,
+        num_slots=6, block_size=16, num_blocks=121,   # 120 allocatable
+        prefill_num_slots=2, prefill_num_blocks=41,   # 40
+        decode_num_slots=6, decode_num_blocks=81,     # 80
+        max_request_len=320, prefill_chunk=64, decode_span=2,
+        short_prompt_lo=16, short_prompt_hi=48,
+        short_new_lo=96, short_new_hi=128,
+        long_fraction=0.125, long_prompt_lo=192, long_prompt_hi=288,
+        long_new_lo=8, long_new_hi=16,
+        # paced UNDER capacity (~500 tok/s offered vs ~600 tok/s the
+        # monolithic arm serves on the capture host): both arms keep up
+        # with arrivals, so throughput parity holds and the TBT tail
+        # reflects per-token service latency — the thing
+        # disaggregation changes — not unbounded backlog wait
+        mean_interarrival_s=0.2, seed=0,
     )
 
 
@@ -777,6 +846,158 @@ def run_continuous(params, config, s: dict, trace,
     }
 
 
+def run_disagg(params, config, s: dict, trace, registry=None,
+               tenant_of=None) -> dict:
+    """Disaggregated arm: one :class:`DisaggRouter` (prefill pool +
+    decode pool + KV migration) replayed with the same open-loop drive
+    as ``run_continuous``.  Latency families are read back through the
+    metrics plane's ``pool``-labeled histograms PromQL-style — the
+    decode-pool TBT series is the headline (those are the lanes whose
+    tail contention with long prompts disaggregation removes).
+
+    With >= 2 devices the pools are placed on separate slices of a
+    2-slice virtual mesh (``DisaggTopology("virtual_multislice")`` —
+    the dp-over-DCN deployment shape) so their dispatches genuinely
+    overlap; on one device they fall back to ``two_cell`` and
+    serialize, which understates disaggregation on CPU.  Handoff
+    backpressure is capped at the decode pool's slot count — prefill
+    never runs further ahead than decode can absorb."""
+    from kubeshare_tpu.constants import (ENV_MEGASCALE_NUM_SLICES,
+                                         ENV_MEGASCALE_SLICE_ID)
+    from kubeshare_tpu.parallel.distributed import multislice_spec_from_env
+    from kubeshare_tpu.serving import (DisaggRouter, DisaggTopology,
+                                       EngineConfig, Request)
+
+    topology = None
+    if len(jax.devices()) >= 2:
+        topology = DisaggTopology("virtual_multislice", multislice_spec_from_env(
+            {ENV_MEGASCALE_NUM_SLICES: "2", ENV_MEGASCALE_SLICE_ID: "0"}))
+    shared = dict(
+        block_size=s["block_size"], max_request_len=s["max_request_len"],
+        prefill_chunk=s["prefill_chunk"],
+        decode_span=s.get("decode_span", 4))
+    router = DisaggRouter(
+        params, config,
+        EngineConfig(num_slots=s["prefill_num_slots"],
+                     num_blocks=s["prefill_num_blocks"], **shared),
+        EngineConfig(num_slots=s["decode_num_slots"],
+                     num_blocks=s["decode_num_blocks"], **shared),
+        tenants=registry, topology=topology,
+        max_pending_handoffs=s.get("max_pending_handoffs",
+                                   s["decode_num_slots"]),
+        decode_priority=s.get("decode_priority"))
+    router.warmup()
+    compiles_before = router.compile_counts()
+
+    start = time.monotonic()
+    pending = list(trace)
+    while pending or not router.idle:
+        now = time.monotonic() - start
+        while pending and pending[0][3] <= now:
+            rid, prompt, max_new, _ = pending.pop(0)
+            router.submit(Request(
+                rid, prompt, max_new,
+                tenant=(tenant_of[rid] if tenant_of else "default")))
+        if not router.step() and pending:
+            time.sleep(min(0.001, pending[0][3] - now))
+    elapsed = time.monotonic() - start
+
+    recompiles = sum(router.compile_counts().values()) - sum(
+        compiles_before.values())
+    useful = sum(min(len(router.result(rid).tokens), max_new)
+                 for rid, _, max_new, _ in trace)
+    ttfts, per_token = [], []
+    requests = {}
+    for rid, _, max_new, arrival in trace:
+        r = router.result(rid)
+        ttfts.append((r.first_token_at - start) - arrival)
+        if len(r.tokens) > 1:
+            per_token.append(
+                (r.finished_at - r.first_token_at) / (len(r.tokens) - 1))
+        requests[rid] = {
+            "arrival_s": arrival,
+            "ttft_s": (r.first_token_at - start) - arrival,
+            "finished_s": (r.finished_at - start) - arrival,
+            "tokens": list(r.tokens),
+        }
+    metric = {(sm.name, tuple(sorted(sm.labels.items()))): sm.value
+              for f in router.collect_metrics() for sm in f.samples}
+
+    def pool_hist(name, pool):
+        view = {k: v for k, v in metric.items()
+                if dict(k[1]).get("pool") == pool}
+        return _metric_histogram(view, name)
+
+    tbt_all = _metric_histogram(metric, "kubeshare_serving_tbt_seconds")
+    tbt_by_pool = {
+        pool: {"p50": _hist_quantile(b, 0.50),
+               "p99": _hist_quantile(b, 0.99)}
+        for pool in ("prefill", "decode")
+        for b in [pool_hist("kubeshare_serving_tbt_seconds", pool)]}
+    # TTFT-by-pool via histogram_quantile over the pool-labeled series:
+    # prefill observes submit->first-token (the user-visible TTFT);
+    # decode observes handoff->first-decode-token (the migration lag)
+    ttft_by_pool = {
+        pool: {"p50": _hist_quantile(b, 0.50),
+               "p95": _hist_quantile(b, 0.95)}
+        for pool in ("prefill", "decode")
+        for b in [pool_hist("kubeshare_serving_ttft_seconds", pool)]}
+    stall_buckets = _metric_histogram(
+        metric, "kubeshare_serving_migration_stall_seconds")
+    stall_count = int(metric[
+        ("kubeshare_serving_migration_stall_seconds_count", ())])
+    stall_sum = float(metric[
+        ("kubeshare_serving_migration_stall_seconds_sum", ())])
+    preemptions = {
+        dict(labels)["tenant"]: int(v)
+        for (name, labels), v in metric.items()
+        if name == "kubeshare_serving_preemptions_total"}
+    dispatches = {
+        f"{dict(labels)['pool']}.{dict(labels)['kind']}": int(v)
+        for (name, labels), v in metric.items()
+        if name == "kubeshare_serving_dispatches_total"
+        and dict(labels)["kind"] in ("prefill_chunk", "decode_span",
+                                     "verify_span", "mixed")
+        and v}
+    return {
+        "topology": (topology.mode if topology is not None
+                     else "two_cell"),
+        "tokens_per_s": useful / elapsed,
+        "useful_tokens": useful,
+        "elapsed_s": elapsed,
+        "ttft_s": _percentiles(ttfts),
+        "per_token_s": _percentiles(per_token),
+        "tbt_s": {"p50": _hist_quantile(tbt_all, 0.50),
+                  "p99": _hist_quantile(tbt_all, 0.99)},
+        "tbt_by_pool_s": tbt_by_pool,
+        "ttft_by_pool_s": ttft_by_pool,
+        "dispatches": dispatches,
+        "prefill_chunks": router.prefill.prefill_chunks,
+        "decode_steps": router.decode.decode_steps,
+        "verify_steps": router.decode.verify_steps,
+        "migration": {
+            "packed": int(metric[("kubeshare_serving_migrations_total",
+                                  (("stage", "packed"),))]),
+            "delivered": int(metric[("kubeshare_serving_migrations_total",
+                                     (("stage", "delivered"),))]),
+            "migrated_bytes": int(metric[
+                ("kubeshare_serving_migrated_bytes_total", ())]),
+            "stall_s": {"p50": _hist_quantile(stall_buckets, 0.50),
+                        "p99": _hist_quantile(stall_buckets, 0.99),
+                        "mean": stall_sum / max(1, stall_count),
+                        "count": stall_count},
+        },
+        "kv_hbm_bytes_peak":
+            router.prefill.peak_blocks_in_use
+            * router.prefill.pool.bytes_per_block()
+            + router.decode.peak_blocks_in_use
+            * router.decode.pool.bytes_per_block(),
+        "preemptions": preemptions,
+        "recompiles": recompiles,
+        "requests": requests,
+    }
+
+
 def run_rtc(params, config, s: dict, trace) -> dict:
     """Run-to-completion baseline: fixed worst-case shapes, batch
     barrier semantics.  One compiled prefill + one compiled decode scan,
@@ -998,6 +1219,93 @@ def run_mixed_bench(s: dict, aba: bool = True) -> dict:
         "tokens_per_s_ratio": on["tokens_per_s"] / max(1e-9, off_tps),
         "tbt_p50_ratio": off_p50 / max(1e-9, on["tbt_s"]["p50"]),
         "tbt_p99_ratio": off_p99 / max(1e-9, on["tbt_s"]["p99"]),
+        "streams_bit_exact": True,
+        "recompiles_after_warmup": recompiles,
+        "platform": jax.default_backend(),
+    }
+
+
+def run_disagg_bench(s: dict, aba: bool = True) -> dict:
+    """Disaggregated prefill/decode vs the monolithic MIXED engine on
+    one long-prefill/steady-decode adversarial trace at equal TOTAL
+    KV-HBM budget ((prefill_blocks-1) + (decode_blocks-1) ==
+    (mono_blocks-1) — asserted, the equal-budget claim is the whole
+    comparison).  The monolithic arm runs with mixed batching ON — the
+    strongest in-pool answer to the same traffic — so the ratio
+    isolates what REMOVING prefill from the decode dispatch buys over
+    merely bounding it.  The acceptance bar (full settings): decode
+    TBT p99 strictly lower disagg-on at parity (>= 1.0x) aggregate
+    tokens/s, every stream bit-exact across arms, zero recompiles
+    after warmup in both pools.  ``aba=False`` drops the second
+    bracketing monolithic run (tests lock mechanics, not timing)."""
+    config, params = _bench_model(s)
+    p_blocks = s["prefill_num_blocks"] - 1
+    d_blocks = s["decode_num_blocks"] - 1
+    mono_blocks = s["num_blocks"] - 1
+    if p_blocks + d_blocks != mono_blocks:
+        raise ValueError(
+            f"disagg KV budget {p_blocks}+{d_blocks} blocks != "
+            f"monolithic budget {mono_blocks} — the equal-HBM "
+            f"comparison requires the split pools to sum to the "
+            f"monolithic pool")
+    trace, longs = build_mixed_workload(s)
+
+    # ABA bracket (docs/perf.md methodology): first-trace-run host
+    # costs bias whichever arm runs first, so the disagg run is
+    # bracketed by two monolithic-mixed runs and compared to their
+    # mean; monolithic streams and dispatch counts are deterministic —
+    # only wall time drifts between A and B.
+    off_a = run_continuous(params, config, s, trace, mixed=True)
+    on = run_disagg(params, config, s, trace)
+    off_b = (run_continuous(params, config, s, trace, mixed=True)
+             if aba else off_a)
+    recompiles = (on.pop("recompiles") + off_a.pop("recompiles")
+                  + (off_b.pop("recompiles") if aba else 0))
+    if recompiles:
+        raise RuntimeError(
+            f"{recompiles} recompilations after warmup — a static-shape "
+            f"leak; the comparison (and a TPU serving pod) is invalid")
+    # handoff correctness, end to end: migrating a prompt's KV chain
+    # between pools may not change a single token of any stream
+    mismatched = [
+        rid for rid in on["requests"]
+        if on["requests"][rid]["tokens"] != off_a["requests"][rid]["tokens"]
+        or on["requests"][rid]["tokens"] != off_b["requests"][rid]["tokens"]]
+    if mismatched:
+        raise RuntimeError(
+            f"streams diverged between disagg and monolithic for "
+            f"{mismatched} — the KV migration is NOT bit-exact")
+    if on["migration"]["delivered"] != on["migration"]["packed"]:
+        raise RuntimeError(
+            f"{on['migration']['packed'] - on['migration']['delivered']} "
+            f"migration(s) packed but never delivered after drain")
+    on.pop("requests")
+    off_a.pop("requests")
+    if aba:
+        off_b.pop("requests")
+    off_tps = (off_a["tokens_per_s"] + off_b["tokens_per_s"]) / 2
+    off_p50 = (off_a["tbt_s"]["p50"] + off_b["tbt_s"]["p50"]) / 2
+    off_p99 = (off_a["tbt_s"]["p99"] + off_b["tbt_s"]["p99"]) / 2
+    decode_tbt = on["tbt_by_pool_s"]["decode"]
+    return {
+        "suite": "serving-disagg",
+        "metric": "decode-pool TBT p99 disagg-on vs monolithic-mixed "
+                  "TBT p99 (same long-prefill/steady-decode Poisson "
+                  "trace, same TOTAL KV-HBM budget split across the "
+                  "pools; TBT read through the metrics plane's "
+                  "pool-labeled histograms; monolithic = mean of the "
+                  "two bracketing runs)",
+        "settings": {k: v for k, v in s.items()},
+        "long_requests": len(longs),
+        "disagg": on,
+        "monolithic_first": off_a,
+        "monolithic_last": off_b,
+        "monolithic": {"tokens_per_s": off_tps,
+                       "tbt_s": {"p50": off_p50, "p99": off_p99},
+                       "mixed_steps": off_a["mixed_steps"]},
+        "tokens_per_s_ratio": on["tokens_per_s"] / max(1e-9, off_tps),
+        "decode_tbt_p50_ratio": off_p50 / max(1e-9, decode_tbt["p50"]),
+        "decode_tbt_p99_ratio": off_p99 / max(1e-9, decode_tbt["p99"]),
         "streams_bit_exact": True,
         "recompiles_after_warmup": recompiles,
         "platform": jax.default_backend(),
@@ -1299,9 +1607,24 @@ def main() -> None:
                         help="self-drafting speculative decoding on/off "
                              "on a phrase-pool repetitive trace "
                              "(dispatches-per-token headline)")
+    parser.add_argument("--disagg", action="store_true",
+                        help="disaggregated prefill/decode pools vs the "
+                             "monolithic mixed engine at equal total "
+                             "KV-HBM budget (decode TBT p99 headline)")
     parser.add_argument("--json", help="write the result JSON here too")
     args = parser.parse_args()
-    if args.speculative:
+    if args.disagg and "host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        # two virtual CPU devices so the pools' dispatches genuinely
+        # overlap (virtual_multislice placement); the flag must land
+        # before the first backend use, which is inside the run
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2")
+    if args.disagg:
+        result = run_disagg_bench(
+            disagg_smoke_settings() if args.smoke else disagg_settings())
+    elif args.speculative:
         result = run_speculative_bench(
             spec_smoke_settings() if args.smoke else spec_settings())
     elif args.tiered:
@@ -1324,6 +1647,23 @@ def main() -> None:
     if args.json:
         with open(args.json, "w") as f:
             f.write(text + "\n")
+    if args.disagg:
+        on, off = result["disagg"], result["monolithic"]
+        mig = on["migration"]
+        print(f"\ndisaggregated prefill/decode: decode-pool TBT p99 "
+              f"{1e3 * on['tbt_by_pool_s']['decode']['p99']:.1f} ms vs "
+              f"{1e3 * off['tbt_s']['p99']:.1f} ms monolithic-mixed "
+              f"({result['decode_tbt_p99_ratio']:.2f}x lower, target "
+              f"> 1x on the full workload); tokens/s ratio "
+              f"{result['tokens_per_s_ratio']:.3f} (target >= 1.0); "
+              f"{mig['delivered']}/{mig['packed']} chains migrated "
+              f"({mig['migrated_bytes'] / 1024:.0f} KiB wire, staging "
+              f"stall p99 {1e3 * mig['stall_s']['p99']:.2f} ms); "
+              f"{on['prefill_chunks']} prefill chunks / "
+              f"{on['decode_steps']} decode spans vs "
+              f"{off['mixed_steps']} fused monolithic dispatches; "
+              f"streams bit-exact", file=sys.stderr)
+        return
     if args.speculative:
         on = result["speculative"]
         print(f"\nspeculative decoding: "

@@ -39,16 +39,28 @@ The serving subsystem the fractional-chip runtime was built to host:
   virtual-time accounting; admission pulls from it instead of FIFO, and
   a Guarantee admission the pool cannot fund preempts an Opportunistic
   decode slot — cache-backed, so the victim resumes bit-exactly from
-  its first uncached token.
+  its first uncached token;
+- :mod:`disagg` — disaggregated prefill/decode serving: a
+  :class:`PrefillPool` and :class:`DecodePool` (role-restricted engine
+  instances with independent allocators and warmup sets), a
+  :class:`KVMigrator` moving finished prompts' block chains across on
+  the versioned tier wire format (guard-only sync — unpacks overlap
+  the decode pool's pipelined dispatch), and a :class:`DisaggRouter`
+  front end preserving bit-exact streams across the handoff, with one
+  shared host tier under both pools' prefix tries as the cross-pool
+  cache bus.
 """
 
+from .disagg import (DecodePool, DisaggRouter, DisaggTopology, KVMigrator,
+                     PrefillPool)
 from .drafter import NGramDrafter
 from .engine import (EngineConfig, Request, RequestResult, ServingEngine,
                      plan_prefill_chunks)
 from .kv_blocks import (BlockAllocator, BlockExhausted, PagedKVPool,
-                        QuotaExceeded, init_paged_pool)
-from .kv_tier import (KV_WIRE_VERSION, HostTier, LRUTierPolicy,
-                      QoSTierPolicy, TierPolicy, pack_block, unpack_block,
+                        QuotaExceeded, chain_token_runs, init_paged_pool)
+from .kv_tier import (KV_CHAIN_VERSION, KV_WIRE_VERSION, HostTier,
+                      LRUTierPolicy, QoSTierPolicy, TierPolicy, pack_block,
+                      pack_chain, unpack_block, unpack_chain,
                       wire_block_bytes)
 from .paged import (paged_copy_block, paged_decode_span, paged_decode_step,
                     paged_gather_kv, paged_mixed_step,
@@ -62,13 +74,19 @@ __all__ = [
     "BlockAllocator",
     "BlockExhausted",
     "DEFAULT_TENANT",
+    "DecodePool",
+    "DisaggRouter",
+    "DisaggTopology",
     "EngineConfig",
     "FairQueue",
     "HostTier",
+    "KVMigrator",
+    "KV_CHAIN_VERSION",
     "KV_WIRE_VERSION",
     "LRUTierPolicy",
     "NGramDrafter",
     "PagedKVPool",
+    "PrefillPool",
     "PrefixIndex",
     "QoSTierPolicy",
     "TierPolicy",
@@ -80,8 +98,10 @@ __all__ = [
     "ServingEngine",
     "TenantRegistry",
     "TenantSpec",
+    "chain_token_runs",
     "init_paged_pool",
     "pack_block",
+    "pack_chain",
     "paged_copy_block",
     "paged_decode_span",
     "paged_decode_step",
@@ -93,5 +113,6 @@ __all__ = [
     "paged_verify_span",
     "plan_prefill_chunks",
     "unpack_block",
+    "unpack_chain",
     "wire_block_bytes",
 ]

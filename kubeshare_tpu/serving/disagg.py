@@ -1,0 +1,764 @@
+"""Disaggregated prefill/decode serving: split pools + KV migration.
+
+KubeShare carves one accelerator into fractional cells with hard
+isolation; this module is the serving-side twin of that idea — run
+PREFILL and DECODE in separate pools (separate fractional cells today,
+separate slices tomorrow) so a long prompt never contends with decode
+lanes for HBM bandwidth or dispatch slots.  It is the architectural
+endgame of the mixed-batching work (ROADMAP): mixed batching bounds how
+much prefill a decode dispatch carries; disaggregation removes the
+contention entirely, the DistServe/Mooncake-lineage shape.
+
+Three pieces:
+
+- :class:`PrefillPool` / :class:`DecodePool` — two
+  :class:`~kubeshare_tpu.serving.engine.ServingEngine` instances with
+  independent block allocators, slot pools, and warmup sets, each
+  restricted to its phase's plan kinds through
+  ``EngineConfig.pool_role`` (the prefill pool warms/dispatches only
+  prefill-chunk shapes and reserves only prompt-cover blocks; the
+  decode pool warms/dispatches only decode/verify shapes and admits
+  exclusively through ``ServingEngine.admit_migrated``);
+- :class:`KVMigrator` — packs a prompt's block chain through the PR 6
+  versioned wire format (``kv_tier.pack_block`` frames inside a
+  ``pack_chain`` envelope) and unpacks it into freshly reserved
+  decode-pool blocks via the warmed ``paged_upload_block`` shape.
+  Serialization is EAGER: blocks whose prompt rows are final are
+  packed while later chunks still prefill (the Mooncake/Splitwise
+  overlap of KV transfer with prefill), so the handoff itself stages
+  only the last chunk's blocks.  Sync is guard-only, so on an
+  unguarded engine the device copy-ins overlap the decode pool's
+  pipelined dispatch — the migration stall is hidden; the host-side
+  staging that is NOT hidden (serialize + deserialize + enqueue) is
+  metered into a stall histogram, and migrated bytes flow through the
+  same ``ledger_hook`` the host tier's demote/promote traffic uses
+  (the interposer's ``Buffer_CopyToDevice`` accounting path);
+- :class:`DisaggRouter` — the front end: admits through the prefill
+  pool's QoS fair queue, tracks each request across the handoff, and
+  preserves BIT-EXACT streams.  The migrated slot is indistinguishable
+  from one that just finished prefill in a monolithic engine: same
+  K/V rows (bit-exact wire round-trip), same emitted first token, same
+  remaining PRNG key schedule, same drafter window and trie hint —
+  so greedy AND sampled streams, speculative on or off, across
+  preemption, match the monolithic engine token for token
+  (test-asserted).
+
+Topology is pluggable (:class:`DisaggTopology`): ``two_cell`` runs
+both pools in-process on the default device (two fractional cells of
+one chip — CPU-testable today), ``virtual_multislice`` places the
+pools on devices from the first and second slice of a
+``dryrun_multichip``-style 2-slice mesh
+(``parallel/distributed.py:slice_device_mesh``) — the dp-over-DCN
+placement a real cross-slice deployment uses, exercised on the 8-CPU
+virtual topology in tier-1 tests.
+
+Each pool keeps its OWN radix prefix index (matching happens where
+admission happens), with one HOST TIER shared underneath as the
+cross-pool cache bus: when either pool demotes a block, the payload
+lands in the shared tier and a host-resident mirror node is adopted
+into the peer pool's trie (``PrefixIndex.adopt_host``), so a prefix
+prefilled once is promotable by whichever pool needs it next.  Mirror
+entries are independent copies — the tier's byte budget pays twice for
+a both-pools-hot prefix, the price of keeping each trie's invariants
+local to its pool.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..parallel.distributed import (MultisliceSpec, multislice_spec_from_env,
+                                    slice_device_mesh)
+from ..utils.promtext import MetricFamily, Sample
+from .engine import (EngineConfig, Request, RequestResult, ServingEngine,
+                     _histogram_samples, _bucket_observe,
+                     plan_prefill_chunks)
+from .kv_blocks import BlockExhausted, QuotaExceeded, chain_token_runs
+from .kv_tier import (HostTier, LRUTierPolicy, QoSTierPolicy, pack_block,
+                      pack_chain, unpack_chain)
+from .qos import TenantRegistry
+
+# Migration staging stall bounds: the HIDDEN cost is zero (device
+# copy-ins overlap the pipelined dispatch); what this histogram sees is
+# host-side serialize/deserialize/enqueue time per migration, normally
+# sub-millisecond per block on CPU — the 10ms+ slots are the alarm.
+MIGRATION_STALL_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                           0.05, 0.1, 0.25, 0.5, 1.0)
+
+# Eager-staging gather width: how many newly-final prompt blocks one
+# router iteration serializes ahead of the handoff (also the smallest
+# warmed read_chain shape).  One prefill chunk covers at most
+# ``prefill_chunk / block_size`` blocks per iteration, so 4 keeps pace
+# with a 64-token chunk over 16-token blocks; the per-iteration cost is
+# a ~4-block gather, thin enough to hide under the dispatch cadence.
+STAGE_GATHER_BLOCKS = 4
+
+# geometry fields both pools must agree on for a migrated slot to be a
+# drop-in continuation (block/table layout, chunk planning, pick policy)
+_SHARED_GEOMETRY = ("block_size", "max_request_len", "prefill_chunk",
+                    "eos_token", "top_k", "top_p", "speculative",
+                    "draft_len", "draft_ngram")
+
+
+class PrefillPool(ServingEngine):
+    """A ServingEngine pinned to the prefill phase: ``pool_role`` is
+    forced to ``"prefill"`` (mixed batching off — a single-phase pool
+    has nothing to fuse) and metric families carry ``pool="prefill"``.
+    Slots reserve only prompt-cover blocks; at prefill completion the
+    router's handoff hook migrates the chain out."""
+
+    def __init__(self, params, config, engine_config=None, **kwargs):
+        ec = replace(engine_config or EngineConfig(),
+                     pool_role="prefill", mixed=False)
+        kwargs.setdefault("pool_label", "prefill")
+        super().__init__(params, config, ec, **kwargs)
+
+
+class DecodePool(ServingEngine):
+    """A ServingEngine pinned to the decode phase: ``pool_role`` is
+    forced to ``"decode"`` and metric families carry ``pool="decode"``.
+    ``submit`` refuses; requests arrive only through
+    :meth:`~kubeshare_tpu.serving.engine.ServingEngine.admit_migrated`."""
+
+    def __init__(self, params, config, engine_config=None, **kwargs):
+        ec = replace(engine_config or EngineConfig(),
+                     pool_role="decode", mixed=False)
+        kwargs.setdefault("pool_label", "decode")
+        super().__init__(params, config, ec, **kwargs)
+
+
+@dataclass(frozen=True)
+class DisaggTopology:
+    """Where the two pools live.
+
+    ``two_cell`` (default): both pools in-process on the default
+    device — two fractional cells of one chip, each pool chargeable
+    through its own ExecutionGuard.  ``virtual_multislice``: place the
+    prefill pool on the first device of slice 0 and the decode pool on
+    the first device of slice 1 of a 2-slice mesh built from the
+    MEGASCALE env contract (``dryrun_multichip``'s virtual topology on
+    CPU; real DCN-separated slices on hardware) — KV migration then
+    crosses the slice boundary exactly where a production deployment's
+    DCN transfer sits."""
+
+    mode: str = "two_cell"
+    # MEGASCALE-style spec for virtual_multislice (None: read the env)
+    multislice: Optional[MultisliceSpec] = None
+
+    def __post_init__(self) -> None:
+        if self.mode not in ("two_cell", "virtual_multislice"):
+            raise ValueError(
+                f"mode must be 'two_cell' or 'virtual_multislice', got "
+                f"{self.mode!r}")
+
+    def place(self) -> Tuple[Optional[object], Optional[object]]:
+        """(prefill_device, decode_device); (None, None) in two-cell
+        mode (both pools ride the default device)."""
+        if self.mode == "two_cell":
+            return None, None
+        ms = self.multislice or multislice_spec_from_env()
+        if ms is None:
+            raise ValueError(
+                "virtual_multislice topology needs a MultisliceSpec "
+                "(pass one, or set the MEGASCALE env like "
+                "dryrun_multichip does)")
+        if len(jax.devices()) < 2:
+            raise ValueError(
+                f"virtual_multislice needs >= 2 devices, have "
+                f"{len(jax.devices())}")
+        mesh = slice_device_mesh(ms)
+        return mesh.devices[0, 0], mesh.devices[1, 0]
+
+
+@dataclass
+class _Ticket:
+    """One in-flight migration: everything the decode pool needs to
+    continue the stream bit-exactly, captured at the instant the
+    prefill pool finished the prompt."""
+
+    rid: str
+    tenant: str
+    prompt: np.ndarray
+    first_token: int
+    max_new: int
+    temperature: float
+    step_keys: np.ndarray
+    payload: bytes                 # pack_chain envelope
+    result: RequestResult
+    emitted_prefix: List[int]
+    last_token_at: Optional[float]
+    hint: Optional[List[int]] = None
+    pack_stall_s: float = 0.0
+    attempts: int = 0
+
+
+class KVMigrator:
+    """Packs a prefill slot's block chain into the PR 6 wire format
+    and unpacks it into the decode pool — eagerly, block by block, as
+    the prompt prefills (:meth:`stage`), with the handoff
+    (:meth:`pack`) serializing only the remainder.  Counters feed the
+    metrics plane; ``ledger_hook(nbytes, "migrate")`` feeds the
+    interposer's CopyToDevice accounting (the same hook shape
+    ``HostTier`` uses for demote/promote bytes)."""
+
+    def __init__(self, decode: ServingEngine, ledger_hook=None) -> None:
+        self.decode = decode
+        self.ledger_hook = ledger_hook
+        self.migrations = 0          # chains packed
+        self.delivered = 0           # chains admitted decode-side
+        self.migrated_bytes = 0      # wire envelope bytes packed
+        self._stall_counts = [0] * (len(MIGRATION_STALL_BUCKETS) + 1)
+        self._stall_sum = 0.0
+        # eager staging: per-rid wire frames packed AHEAD of the
+        # handoff while the prompt is still prefilling, plus the host
+        # seconds spent producing them (folded into the chain's stall)
+        self._staged: Dict[str, List[bytes]] = {}
+        self._staged_secs: Dict[str, float] = {}
+
+    def stage(self, engine: ServingEngine, pool_snapshot,
+              settled: Dict[str, int]) -> None:
+        """Eagerly serialize prompt blocks that are already FINAL while
+        their prompt is still prefilling — the Mooncake/Splitwise-style
+        overlap of KV transfer with prefill, so the handoff packs only
+        the last chunk's blocks instead of the whole chain in one lump.
+        Reads go to ``pool_snapshot`` (the pool as of the PREVIOUS
+        router iteration, whose producing dispatch has long retired) so
+        staging never synchronizes with in-flight work; ``settled``
+        maps rid -> prompt tokens materialized in that snapshot.  At
+        most :data:`STAGE_GATHER_BLOCKS` blocks are packed per call —
+        the per-iteration cost stays a thin, bounded slice."""
+        live = {s.rid: s for s in engine._slots if s.state == "prefill"}
+        for rid in [r for r in self._staged if r not in live]:
+            # finished without a handoff (single-token stream) or
+            # otherwise gone: the frames will never be packed
+            del self._staged[rid]
+            self._staged_secs.pop(rid, None)
+        budget = STAGE_GATHER_BLOCKS
+        bs = engine.engine_config.block_size
+        for rid, done_tokens in settled.items():
+            slot = live.get(rid)
+            if slot is None or budget <= 0:
+                continue
+            frames = self._staged.setdefault(rid, [])
+            if len(frames) > done_tokens // bs:
+                # progress went backwards: a fresh incarnation of the
+                # rid reuses the id with new blocks — restart staging
+                frames.clear()
+                self._staged_secs.pop(rid, None)
+            take = min(done_tokens // bs - len(frames), budget)
+            if take <= 0:
+                continue
+            budget -= take
+            t0 = time.monotonic()
+            runs = chain_token_runs(slot.prompt, bs)
+            n = len(frames)
+            slabs = pool_snapshot.read_chain(
+                [int(slot.table[i]) for i in range(n, n + take)],
+                pad_to=STAGE_GATHER_BLOCKS)
+            frames.extend(
+                pack_block(runs[n + j], k_slab, v_slab)
+                for j, (k_slab, v_slab) in enumerate(slabs))
+            self._staged_secs[rid] = (self._staged_secs.get(rid, 0.0)
+                                      + time.monotonic() - t0)
+
+    def pack(self, engine: ServingEngine, slot) -> _Ticket:
+        """Serialize ``slot``'s prompt chain (called from the prefill
+        pool's handoff hook, BEFORE the slot's blocks are reclaimed).
+        Blocks already serialized by :meth:`stage` are reused verbatim;
+        only the remainder — normally the final chunk's blocks plus the
+        partial tail — is read and packed here, so the handoff-time
+        lump is a few blocks, not the chain.  The stall metered per
+        migration is the TOTAL staging time (eager + handoff
+        remainder)."""
+        t0 = time.monotonic()
+        ec = engine.engine_config
+        runs = chain_token_runs(slot.prompt, ec.block_size)
+        frames = self._staged.pop(slot.rid, [])
+        eager_s = self._staged_secs.pop(slot.rid, 0.0)
+        n = len(frames)
+        if n > len(runs):  # stale incarnation: restage everything
+            frames, n, eager_s = [], 0, 0.0
+        if n < len(runs):
+            rem = len(runs) - n
+            # smallest warmed gather width that covers the remainder
+            width = (STAGE_GATHER_BLOCKS if rem <= STAGE_GATHER_BLOCKS
+                     else 2 * STAGE_GATHER_BLOCKS
+                     if rem <= 2 * STAGE_GATHER_BLOCKS
+                     else engine._table_width)
+            slabs = engine.pool.read_chain(
+                [int(slot.table[i]) for i in range(n, len(runs))],
+                pad_to=width)
+            frames = frames + [
+                pack_block(runs[n + j], k_slab, v_slab)
+                for j, (k_slab, v_slab) in enumerate(slabs)]
+        payload = pack_chain(frames)
+        hint = (slot.drafter.hint_window
+                if slot.drafter is not None else None)
+        ticket = _Ticket(
+            rid=slot.rid, tenant=slot.tenant,
+            prompt=np.array(slot.prompt, np.int32),
+            first_token=int(slot.generated[0]), max_new=slot.max_new,
+            temperature=slot.temperature,
+            step_keys=np.array(slot.step_keys, np.uint32),
+            payload=payload, result=slot.result,
+            emitted_prefix=list(slot.emitted_prefix),
+            last_token_at=slot.last_token_at, hint=hint,
+            pack_stall_s=eager_s + time.monotonic() - t0)
+        self.migrations += 1
+        self.migrated_bytes += len(payload)
+        if self.ledger_hook is not None:
+            self.ledger_hook(len(payload), "migrate")
+        return ticket
+
+    def deliver(self, ticket: _Ticket) -> bool:
+        """Unpack ``ticket`` into freshly reserved decode-pool blocks;
+        False when the decode pool cannot place it right now (no free
+        slot / unfundable reservation) — the router retries after the
+        pool's next step, or preempts for a Guarantee ticket.  On
+        success the full staging time (pack + unpack + upload enqueue;
+        the device copy-in overlaps the pipelined dispatch) lands in
+        the stall histogram."""
+        ticket.attempts += 1
+        t0 = time.monotonic()
+        frames = unpack_chain(ticket.payload)
+        ok = self.decode.admit_migrated(
+            rid=ticket.rid, tenant=ticket.tenant, prompt=ticket.prompt,
+            first_token=ticket.first_token, max_new=ticket.max_new,
+            temperature=ticket.temperature, step_keys=ticket.step_keys,
+            payloads=frames, result=ticket.result,
+            emitted_prefix=ticket.emitted_prefix,
+            last_token_at=ticket.last_token_at, hint=ticket.hint)
+        if not ok:
+            return False
+        self.delivered += 1
+        stall = ticket.pack_stall_s + (time.monotonic() - t0)
+        self._stall_sum += stall
+        _bucket_observe(self._stall_counts, stall,
+                        MIGRATION_STALL_BUCKETS)
+        return True
+
+    def collect_metrics(self) -> List[MetricFamily]:
+        mig = MetricFamily(
+            "kubeshare_serving_migrations_total",
+            "KV chain migrations by stage (packed = prefill chains "
+            "serialized, delivered = chains admitted into the decode "
+            "pool; packed - delivered are pending).", "counter")
+        mig.add({"stage": "packed"}, self.migrations)
+        mig.add({"stage": "delivered"}, self.delivered)
+        mbytes = MetricFamily(
+            "kubeshare_serving_migrated_bytes_total",
+            "Wire-format bytes migrated prefill -> decode.", "counter")
+        mbytes.add({}, self.migrated_bytes)
+        stall = MetricFamily(
+            "kubeshare_serving_migration_stall_seconds",
+            "Host-side migration staging time per delivered chain "
+            "(serialize + deserialize + upload enqueue; the device "
+            "copy-in overlaps the decode pool's pipelined dispatch).",
+            "histogram")
+        _histogram_samples(
+            stall, "kubeshare_serving_migration_stall_seconds", {},
+            self._stall_counts, self._stall_sum,
+            MIGRATION_STALL_BUCKETS)
+        return [mig, mbytes, stall]
+
+
+class DisaggRouter:
+    """The disaggregated front end: one :class:`PrefillPool`, one
+    :class:`DecodePool`, a :class:`KVMigrator` between them, and a
+    submit/step/run surface shaped like ``ServingEngine``'s so callers
+    (bench, examples, tests) swap it in directly.
+
+    ``prefill_config`` / ``decode_config`` size the two pools
+    independently (slots, blocks, host budgets); the fields in
+    ``_SHARED_GEOMETRY`` must agree — asserted loudly here, because a
+    silent mismatch would corrupt streams, not crash.  Tenant quotas
+    are split across the pools proportionally to each pool's share of
+    total allocatable blocks (``TenantRegistry.pool_view``), so the
+    aggregate contract tracks the monolithic one.
+
+    ``shared_tier_bytes`` turns on the cross-pool host tier (the cache
+    bus); ``ledger_hook(nbytes, kind)`` sees every demote/promote/
+    migrate byte — wire it to
+    ``TokenClient.request_memory`` and the interposer's fractional-HBM
+    ledger accounts the traffic like any ``Buffer_CopyToDevice``.
+
+    ``max_pending_handoffs`` makes prefill admission RESERVE decode
+    capacity: a prompt starts prefilling only when a free decode slot
+    (net of in-flight prefills and undelivered tickets) can absorb its
+    handoff, with at most that many prefills in flight at once.  The
+    backlog waits in the fair queue — where the wait is TTFT, exactly
+    as in a monolithic engine — instead of as first-token-emitted
+    streams stalled at the handoff.  ``None`` (default) disables the
+    gate.
+
+    ``decode_priority=K`` paces prefill against decode activity: while
+    the decode pool is dispatching, the prefill pool advances at most
+    once per ``K`` decode steps (and freely whenever decode goes
+    idle).  On pools sharing compute — two fractional cells of one
+    chip, or one host emulating both slices — this bounds how often a
+    prefill chunk can land in front of a decode span, the collision
+    mixed batching pays on EVERY dispatch with prefill pending; on
+    truly separate slices there is no collision and the pacing merely
+    defers prefill the decode pool never felt.  ``None`` (default)
+    alternates the pools every step."""
+
+    def __init__(
+        self,
+        params,
+        config,
+        prefill_config: EngineConfig,
+        decode_config: EngineConfig,
+        guard=None,
+        decode_guard=None,
+        tenants: Optional[TenantRegistry] = None,
+        topology: Optional[DisaggTopology] = None,
+        shared_tier_bytes: Optional[int] = None,
+        tier_policy: str = "lru",
+        ledger_hook=None,
+        max_pending_handoffs: Optional[int] = None,
+        decode_priority: Optional[int] = None,
+    ) -> None:
+        for name in _SHARED_GEOMETRY:
+            pv, dv = (getattr(prefill_config, name),
+                      getattr(decode_config, name))
+            if pv != dv:
+                raise ValueError(
+                    f"prefill/decode pools disagree on {name}: "
+                    f"{pv!r} vs {dv!r} — shared geometry is what makes "
+                    f"a migrated slot a drop-in continuation")
+        self.tenants = tenants or TenantRegistry.default()
+        p_share = prefill_config.num_blocks - 1
+        d_share = decode_config.num_blocks - 1
+        total = p_share + d_share
+        self.topology = topology or DisaggTopology()
+        p_dev, d_dev = self.topology.place()
+
+        self.shared_tier: Optional[HostTier] = None
+        if shared_tier_bytes is not None:
+            policy = (LRUTierPolicy() if tier_policy == "lru"
+                      else QoSTierPolicy(self.tenants))
+            self.shared_tier = HostTier(shared_tier_bytes, policy,
+                                        on_drop=self._route_drop,
+                                        ledger_hook=ledger_hook)
+
+        def build(cls, ec, dev, pool_guard):
+            kwargs = dict(guard=pool_guard,
+                          tenants=self.tenants.pool_view(
+                              (p_share if cls is PrefillPool else d_share)
+                              / total),
+                          shared_host_tier=self.shared_tier,
+                          tier_ledger_hook=(ledger_hook
+                                            if self.shared_tier is None
+                                            else None))
+            if dev is None:
+                return cls(params, config, ec, **kwargs)
+            with jax.default_device(dev):
+                eng = cls(jax.device_put(params, dev), config, ec,
+                          **kwargs)
+            # commit the freshly initialised KV slabs to the pool's
+            # device: step outputs are committed arrays, so an
+            # uncommitted initial pool would give the FIRST warmup
+            # compile of each program a different jit cache key than
+            # every later dispatch — a guaranteed recompile after
+            # warmup on any shape the warmup set touches only once
+            eng.pool = replace(eng.pool,
+                               k=jax.device_put(eng.pool.k, dev),
+                               v=jax.device_put(eng.pool.v, dev))
+            return eng
+
+        self.prefill = build(PrefillPool, prefill_config, p_dev, guard)
+        self.decode = build(DecodePool, decode_config, d_dev,
+                            decode_guard if decode_guard is not None
+                            else guard)
+        self.migrator = KVMigrator(self.decode, ledger_hook=ledger_hook)
+        self.prefill.on_handoff = self._handoff
+        self.decode.on_preempt_requeue = self._forward_resume
+        if self.shared_tier is not None:
+            self.prefill.on_tier_demote = self._mirror(self.decode)
+            self.decode.on_tier_demote = self._mirror(self.prefill)
+        self._tickets: List[_Ticket] = []
+        self._results: Dict[str, RequestResult] = {}
+        # eager-staging snapshot: the prefill pool object and per-rid
+        # settled-token counts as of the END of the last step() — one
+        # iteration stale, so reads against it never wait on in-flight
+        # dispatches (see KVMigrator.stage)
+        self._stage_pool = None
+        self._stage_settled: Dict[str, int] = {}
+        if decode_priority is not None and decode_priority < 1:
+            raise ValueError(
+                f"decode_priority must be >= 1, got {decode_priority}")
+        self._decode_priority = decode_priority
+        self._decode_streak = 0
+        if max_pending_handoffs is not None:
+            # handoff backpressure: a stream's first token is emitted at
+            # prefill completion, so every finished-but-undelivered
+            # prompt is a STALLED stream, not progress.  Admission into
+            # the prefill pool therefore RESERVES decode capacity: a
+            # prompt starts prefilling only when a free decode slot —
+            # net of in-flight prefills and pending tickets — can
+            # absorb its handoff, capped at ``max_pending_handoffs``
+            # prefill-ahead.  The backlog waits in the fair queue,
+            # where it is TTFT (as in a monolithic engine), instead of
+            # inflating the decode pool's inter-token tail by a whole
+            # stream's lifetime.
+            def gate() -> bool:
+                staged = sum(s.state != "free"
+                             for s in self.prefill._slots)
+                free_d = sum(s.state == "free"
+                             for s in self.decode._slots)
+                return (staged + len(self._tickets)
+                        < min(max_pending_handoffs, free_d))
+            self.prefill.admission_gate = gate
+
+    # ------------------------------------------------------------------
+    def submit(self, request: Request) -> RequestResult:
+        """Queue a request into the prefill pool.  Decode-side lifetime
+        feasibility is checked HERE (loudly): a request the decode pool
+        could never hold must not burn prefill work first."""
+        prompt = np.asarray(request.prompt, np.int32)
+        if prompt.ndim == 1 and prompt.size >= 1 \
+                and request.max_new_tokens >= 1 \
+                and request.tenant in self.decode.tenants:
+            alloc = self.decode.allocator
+            needed = alloc.blocks_for_tokens(
+                prompt.size + request.max_new_tokens)
+            if needed > alloc.num_blocks - 1:
+                raise BlockExhausted(
+                    f"request {request.rid!r} needs {needed} decode-pool "
+                    f"blocks but that pool only has "
+                    f"{alloc.num_blocks - 1} — it can NEVER migrate in "
+                    f"(grow the decode pool or shrink the request)")
+            quota = self.decode.tenants.get(request.tenant).kv_block_quota
+            if quota is not None and needed > quota:
+                raise QuotaExceeded(
+                    f"request {request.rid!r} needs {needed} decode-pool "
+                    f"blocks but tenant {request.tenant!r}'s decode-side "
+                    f"quota is {quota} — it can NEVER migrate in")
+        result = self.prefill.submit(request)
+        self._results[request.rid] = result
+        return result
+
+    def step(self) -> bool:
+        """One routing iteration: try pending deliveries, advance the
+        prefill pool (handoffs append tickets), deliver fresh tickets,
+        advance the decode pool.  Returns False only when everything —
+        both pools and the ticket list — is drained."""
+        worked = self._drain_tickets()
+        if self._stage_pool is not None:
+            # serialize a few already-final prompt blocks ahead of
+            # their handoff (from last iteration's settled snapshot)
+            self.migrator.stage(self.prefill, self._stage_pool,
+                                self._stage_settled)
+        if self._decode_priority is None:
+            worked |= self.prefill.step()
+            worked |= self._drain_tickets()
+            worked |= self.decode.step()
+        else:
+            # decode-priority pacing: decode first, prefill only when
+            # decode idles or its turn comes up (1 per K decode steps)
+            d_worked = self.decode.step()
+            worked |= d_worked
+            self._decode_streak = (self._decode_streak + 1
+                                   if d_worked else 0)
+            if not d_worked \
+                    or self._decode_streak >= self._decode_priority:
+                self._decode_streak = 0
+                worked |= self.prefill.step()
+                worked |= self._drain_tickets()
+        self._stage_pool = self.prefill.pool
+        self._stage_settled = {
+            s.rid: (s.plan[0][0] if s.plan else s.prompt.size)
+            for s in self.prefill._slots if s.state == "prefill"}
+        if self._tickets and not worked:
+            # nothing moved anywhere yet a ticket is stuck: with the
+            # decode pool fully idle its reservation can never succeed
+            # (submit() pre-checked sizing, so this is state corruption
+            # — fail loudly rather than spin)
+            raise RuntimeError(
+                f"migration deadlock: {len(self._tickets)} ticket(s) "
+                f"undeliverable with both pools idle (head: "
+                f"{self._tickets[0].rid!r})")
+        return worked or bool(self._tickets)
+
+    def run(self) -> Dict[str, RequestResult]:
+        """Drain everything; returns results by request id."""
+        try:
+            while self.step():
+                pass
+        finally:
+            done = set()
+            for eng in (self.prefill, self.decode):
+                if eng.guard is not None and id(eng.guard) not in done:
+                    done.add(id(eng.guard))
+                    eng.guard.finish()
+        return dict(self._results)
+
+    @property
+    def idle(self) -> bool:
+        return (not self._tickets and self.prefill.idle
+                and self.decode.idle)
+
+    def result(self, rid: str) -> RequestResult:
+        return self._results[rid]
+
+    def pop_finished(self) -> Dict[str, RequestResult]:
+        """Remove and return every completed result (the live-loop
+        eviction point) — drains all three maps so a forever-stepping
+        server does not grow without bound."""
+        done = {rid: r for rid, r in self._results.items() if r.done}
+        for rid in done:
+            del self._results[rid]
+        self.prefill.pop_finished()
+        self.decode.pop_finished()
+        return done
+
+    def warmup(self) -> None:
+        self.prefill.warmup()
+        self.decode.warmup()
+        # the migration pack/stage gather shapes: compile each padded
+        # width here, not under the first migration's metered stall
+        for width in {STAGE_GATHER_BLOCKS, 2 * STAGE_GATHER_BLOCKS,
+                      self.prefill._table_width}:
+            self.prefill.pool.read_chain([0], pad_to=width)
+
+    def compile_counts(self) -> Dict[str, int]:
+        """Both pools' jit cache sizes, keys prefixed ``prefill.`` /
+        ``decode.`` — the zero-recompile assertion's raw data."""
+        counts = {f"prefill.{k}": v
+                  for k, v in self.prefill.compile_counts().items()}
+        counts.update({f"decode.{k}": v
+                       for k, v in self.decode.compile_counts().items()})
+        return counts
+
+    # ------------------------------------------------------------------
+    def collect_metrics(self) -> List[MetricFamily]:
+        """Both pools' families merged (same-name families concatenate
+        their samples — the ``pool`` label keeps series distinct where
+        it is set; unlabeled families sum), plus the migrator's
+        families.  Shared-tier gauges are reported ONCE from the tier
+        itself — both pools read the same store, so summing their
+        copies would double-count."""
+        merged: Dict[str, MetricFamily] = {}
+        shared_once = {"kubeshare_serving_tier_host_bytes"}
+        for i, eng in enumerate((self.prefill, self.decode)):
+            for fam in eng.collect_metrics():
+                if self.shared_tier is not None \
+                        and fam.name in shared_once and i > 0:
+                    continue  # one copy of the shared store's gauges
+                have = merged.get(fam.name)
+                if have is None:
+                    merged[fam.name] = fam
+                    continue
+                self._merge_samples(have, fam)
+        if self.shared_tier is not None:
+            # host_evicted reaches both pools' tier_blocks families
+            # from the one shared store: rebuild that sample once
+            fam = merged["kubeshare_serving_tier_blocks_total"]
+            fam.samples = [
+                s for s in fam.samples
+                if s.labels.get("event") != "host_evicted"]
+            fam.add({"event": "host_evicted"},
+                    self.shared_tier.evicted_blocks)
+        return list(merged.values()) + self.migrator.collect_metrics()
+
+    @staticmethod
+    def _merge_samples(dst: MetricFamily, src: MetricFamily) -> None:
+        index = {(s.name, tuple(sorted(s.labels.items()))): s
+                 for s in dst.samples}
+        for s in src.samples:
+            key = (s.name, tuple(sorted(s.labels.items())))
+            have = index.get(key)
+            if have is None:
+                dst.samples.append(s)
+                index[key] = s
+            else:
+                # same series from both pools (unlabeled families):
+                # counters/gauges sum
+                merged = Sample(have.name, have.labels,
+                                have.value + s.value)
+                dst.samples[dst.samples.index(have)] = merged
+                index[key] = merged
+
+    # ------------------------------------------------------------------
+    def _handoff(self, slot) -> None:
+        """Prefill-pool hook: the slot just produced its first token
+        and still owes more — pack the chain NOW (the caller reclaims
+        the blocks right after) and queue the ticket; delivery is
+        attempted at the next drain point so the prefill pool's step
+        finishes first (the decode upload then overlaps it)."""
+        self._tickets.append(self.migrator.pack(self.prefill, slot))
+
+    def _drain_tickets(self) -> bool:
+        progressed = False
+        while self._tickets:
+            ticket = self._tickets[0]
+            if self.migrator.deliver(ticket):
+                self._tickets.pop(0)
+                progressed = True
+                continue
+            spec = self.decode.tenants.get(ticket.tenant)
+            if spec.is_guarantee and self.decode._preempt_victim():
+                # cache-backed preemption decode-side; the victim's
+                # resume routes back through the prefill pool
+                # (_forward_resume)
+                progressed = True
+                continue
+            break
+        return progressed
+
+    def _forward_resume(self, tenant: str, pending) -> None:
+        """Decode-pool preemption hook: a victim's resume must
+        RE-PREFILL (its cached tail re-materializes where prefill
+        runs), so the pending entry is re-planned with the prefill
+        pool's geometry and requeued at the front of its lane there —
+        the key schedule rides along untouched, keeping the resumed
+        stream bit-exact."""
+        ec = self.prefill.engine_config
+        plan, cover = plan_prefill_chunks(
+            pending.prompt.size, ec.prefill_chunk, ec.max_request_len)
+        pending.plan = plan
+        pending.needed = self.prefill.allocator.blocks_for_tokens(
+            self.prefill._lifetime_rows(
+                pending.prompt.size, pending.max_new, cover))
+        self.prefill._queue.requeue_front(tenant, pending)
+
+    # ------------------------------------------------------------------
+    def _mirror(self, peer: ServingEngine):
+        """Make one pool's ``on_tier_demote`` hook: when THIS pool
+        demotes a block into the shared tier, insert an independent
+        copy of the payload under the PEER pool's trie as a
+        host-resident node — the cross-pool cache bus.  Adoption can
+        decline (missing ancestor, overlapping run): then the mirror
+        copy is forgotten and only the demoting pool's entry remains.
+        Pure host work, safe under the demoting pool's allocator
+        lock."""
+        def on_demote(node, payload: bytes, tenant) -> None:
+            src = (self.prefill if peer is self.decode
+                   else self.decode).prefix_index
+            tokens = src.path_tokens(node)
+            key = self.shared_tier.put(payload, tenant, None)
+            if key is None:
+                return  # budget/policy refused the mirror copy
+            adopted = peer.prefix_index.adopt_host(tokens, key)
+            if adopted is None:
+                self.shared_tier.forget(key)
+            else:
+                self.shared_tier.bind_node(key, adopted)
+        return on_demote
+
+    def _route_drop(self, entry) -> None:
+        """Shared tier's budget-eviction hook: route the dying entry to
+        whichever pool's trie holds its node.  A mirror inserted with
+        ``node=None`` and evicted before ``bind_node`` ran has no trie
+        presence yet — nothing to detach."""
+        if entry.node is None:
+            return
+        if self.prefill.prefix_index.owns(entry.node):
+            self.prefill._drop_host_entry(entry)
+        else:
+            self.decode._drop_host_entry(entry)

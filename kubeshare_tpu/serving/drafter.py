@@ -63,6 +63,14 @@ class NGramDrafter:
         """The primary lookup window (prompt + generated so far)."""
         return list(self._history)
 
+    @property
+    def hint_window(self) -> Optional[List[int]]:
+        """The secondary lookup window, or None when none was installed
+        — what a disaggregated handoff carries so the decode pool can
+        rebuild the drafter bit-identically (rebuilding from the trie
+        on the decode side could differ: the pools' tries diverge)."""
+        return list(self._hint) if self._hint else None
+
     def extend(self, tokens: Sequence[int]) -> None:
         """Append emitted (verified) tokens to the lookup window."""
         self._history.extend(int(t) for t in tokens)

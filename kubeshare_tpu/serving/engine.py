@@ -287,6 +287,16 @@ class EngineConfig:
     draft_len: int = 4
     # the drafter's maximum n-gram order (longest suffix looked up)
     draft_ngram: int = 3
+    # DISAGGREGATED serving role (serving/disagg.py): "both" is the
+    # monolithic engine; "prefill" runs only prefill plan kinds and
+    # hands finished prompts to a decode pool (its slots reserve only
+    # the prompt-cover blocks — decode rows are never written there);
+    # "decode" runs only decode/verify kinds and admits exclusively
+    # through admit_migrated().  Role gating changes WHICH warmed
+    # shapes exist and where a request's lifetime rows live, never the
+    # emitted streams — the router hard-asserts bit-exactness against
+    # a monolithic engine.
+    pool_role: str = "both"
 
 
 @dataclass
@@ -460,6 +470,9 @@ class ServingEngine:
         engine_config: Optional[EngineConfig] = None,
         guard=None,
         tenants: Optional[TenantRegistry] = None,
+        pool_label: Optional[str] = None,
+        shared_host_tier: Optional[HostTier] = None,
+        tier_ledger_hook=None,
     ) -> None:
         ec = engine_config or EngineConfig()
         if ec.max_request_len > config.max_seq_len:
@@ -492,6 +505,25 @@ class ServingEngine:
         if ec.draft_ngram < 1:
             raise ValueError(
                 f"draft_ngram must be >= 1, got {ec.draft_ngram}")
+        if ec.pool_role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"pool_role must be 'both', 'prefill' or 'decode', got "
+                f"{ec.pool_role!r}")
+        if ec.pool_role != "both" and ec.mixed:
+            raise ValueError(
+                f"pool_role {ec.pool_role!r} excludes mixed batching — "
+                f"a single-phase pool has no prefill+decode coexistence "
+                f"to fuse; set mixed=False")
+        if shared_host_tier is not None and ec.host_tier_bytes is not None:
+            raise ValueError(
+                "shared_host_tier and host_tier_bytes are mutually "
+                "exclusive — the disagg router owns the shared tier's "
+                "budget")
+        if shared_host_tier is not None and not ec.prefix_cache:
+            raise ValueError(
+                "shared_host_tier requires prefix_cache=True — the tier "
+                "spills the radix index; there is nothing to spill "
+                "without it")
         # fail fast on a bad filter set, like the dense sampling entries
         _filter_logits(jnp.zeros((1, 2)), ec.top_k, ec.top_p)
         self.params = params
@@ -518,10 +550,19 @@ class ServingEngine:
             policy = (LRUTierPolicy() if ec.tier_policy == "lru"
                       else QoSTierPolicy(self.tenants))
             self.host_tier = HostTier(ec.host_tier_bytes, policy,
-                                      on_drop=self._drop_host_entry)
+                                      on_drop=self._drop_host_entry,
+                                      ledger_hook=tier_ledger_hook)
             # the index purges a detached host descendant's tier entry
             # through this hook (evict of a device ancestor, displaced
             # leaf upgrades)
+            self.prefix_index.host_drop = self.host_tier.forget
+        elif shared_host_tier is not None:
+            # disaggregated mode: the router's one tier sits under BOTH
+            # pools' tries (the cross-pool cache bus).  The router owns
+            # on_drop (it must route an entry to whichever pool's trie
+            # holds its node); this pool only needs forget wired so its
+            # own detach paths purge entries it owns.
+            self.host_tier = shared_host_tier
             self.prefix_index.host_drop = self.host_tier.forget
         self.allocator = BlockAllocator(
             ec.num_blocks, ec.block_size,
@@ -546,6 +587,23 @@ class ServingEngine:
         # uncapped Guarantee tenant, making this exactly a FIFO.
         self._queue = FairQueue(self.tenants)
         self._results: Dict[str, RequestResult] = {}
+        # disaggregation surface (serving/disagg.py): pool_label tags
+        # this engine's metric families; the hooks are router-installed
+        # seams — on_handoff(slot) fires at prefill completion instead
+        # of entering decode, on_preempt_requeue(tenant, pending)
+        # reroutes a preemption's resume entry (the router re-plans it
+        # with PREFILL-pool geometry), on_tier_demote(node, payload,
+        # tenant) mirrors a demoted block into the peer pool's trie.
+        self.pool_label = pool_label
+        self.on_handoff = None
+        self.on_preempt_requeue = None
+        self.on_tier_demote = None
+        # admission_gate() -> bool consulted before each queue pop: the
+        # router's handoff backpressure (a prefill pool must not run
+        # further ahead than the decode pool can absorb — a first token
+        # with no decode capacity behind it is a stalled stream, not
+        # progress).  None = admit whenever a slot and blocks exist.
+        self.admission_gate = None
         # counters (the bench's and the metrics endpoint's raw material):
         # prefill_chunks / decode_steps / verify_steps count WORK UNITS
         # (chunks processed, spans/verify chunks run — standalone or
@@ -721,6 +779,20 @@ class ServingEngine:
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
+    def _lifetime_rows(self, prompt_len: int, max_new: int,
+                       cover: int) -> int:
+        """Cache rows a request occupies over its life in THIS pool: a
+        prefill-role pool only ever writes the prompt's K/V (decode
+        rows land in the decode pool after migration), so it reserves
+        just the chunk-plan cover — the HBM saving that makes a small
+        prefill cell viable.  Everywhere else: the full lifetime.  The
+        max_request_len feasibility check stays on FULL rows (submit) —
+        a request the decode pool can never hold must fail loudly up
+        front."""
+        if self.engine_config.pool_role == "prefill":
+            return cover
+        return max(cover, prompt_len + max_new)
+
     def submit(self, request: Request) -> RequestResult:
         """Queue a request; validation failures raise HERE (loudly), a
         merely-busy pool queues."""
@@ -743,6 +815,10 @@ class ServingEngine:
         except KeyError as exc:
             raise ValueError(str(exc)) from None
         ec = self.engine_config
+        if ec.pool_role == "decode":
+            raise RuntimeError(
+                "a decode-role pool admits only through admit_migrated() "
+                "— submit to the DisaggRouter (or the prefill pool)")
         plan, cover = plan_prefill_chunks(
             prompt.size, ec.prefill_chunk, ec.max_request_len)
         total_rows = max(cover, prompt.size + request.max_new_tokens)
@@ -753,7 +829,8 @@ class ServingEngine:
                 f"{total_rows} cache rows, over max_request_len "
                 f"{ec.max_request_len}"
             )
-        needed = self.allocator.blocks_for_tokens(total_rows)
+        needed = self.allocator.blocks_for_tokens(
+            self._lifetime_rows(prompt.size, request.max_new_tokens, cover))
         if needed > self.allocator.num_blocks - 1:
             raise BlockExhausted(
                 f"request {request.rid!r} needs {needed} blocks but the "
@@ -778,6 +855,97 @@ class ServingEngine:
             temperature=request.temperature, plan=plan, needed=needed,
             rng=request.rng))
         return result
+
+    def admit_migrated(
+        self, *,
+        rid: str,
+        tenant: str,
+        prompt: np.ndarray,
+        first_token: int,
+        max_new: int,
+        temperature: float,
+        step_keys: np.ndarray,
+        payloads: List[bytes],
+        result: RequestResult,
+        emitted_prefix: List[int],
+        last_token_at: Optional[float],
+        hint: Optional[List[int]] = None,
+    ) -> bool:
+        """Admit a request that finished prefill in ANOTHER pool: the
+        disagg router's decode-side entry point.  Reserves the full
+        decode lifetime's blocks, uploads each migrated wire frame
+        through the warmed ``paged_upload_block`` shape (pipelined —
+        guard-only sync, so unpacks overlap the in-flight decode
+        dispatch), and builds a slot indistinguishable from one that
+        just passed :meth:`_finish_prefill` here: ``length`` is the
+        prompt, ``generated`` is the first (prefill-pool-picked) token,
+        the key schedule continues at ``step_keys[0]``, the drafter's
+        window is ``prompt + [first_token]`` with the prefill-side
+        trie hint carried over — so every later emission is bit-exact
+        with the monolithic engine by construction.
+
+        Returns False (reserving nothing) when no slot is free or the
+        reservation cannot be funded — the router keeps the ticket
+        pending and retries after this pool's next step (or preempts).
+        """
+        ec = self.engine_config
+        if ec.pool_role == "prefill":
+            raise RuntimeError(
+                "a prefill-role pool cannot admit migrated requests")
+        spec = self.tenants.get(tenant)
+        slot = next((s for s in self._slots if s.state == "free"), None)
+        if slot is None:
+            return False
+        prompt = np.asarray(prompt, np.int32)
+        needed = self.allocator.blocks_for_tokens(prompt.size + max_new)
+        if len(payloads) > needed:
+            raise ValueError(
+                f"migrated chain has {len(payloads)} blocks but the "
+                f"decode lifetime only spans {needed}")
+        evict_first = (set(self.tenants.opportunistic())
+                       if spec.is_guarantee else None)
+        try:
+            blocks = self.allocator.reserve(
+                needed, rid, tenant=spec.name,
+                quota=spec.kv_block_quota,
+                evict_tenants_first=evict_first)
+        except (BlockExhausted, QuotaExceeded):
+            return False
+        for payload, dst in zip(payloads, blocks):
+            _, k_slab, v_slab = unpack_block(payload)
+            pk, pv = self._dispatch(
+                self._upload_step, self.pool.k, self.pool.v,
+                jnp.asarray(dst, jnp.int32),
+                jnp.asarray(k_slab), jnp.asarray(v_slab))
+            self.pool = replace(self.pool, k=pk, v=pv)
+        slot.state = "decode"
+        slot.rid = rid
+        slot.tenant = spec.name
+        slot.blocks = list(blocks)
+        slot.table[:] = 0
+        slot.table[: len(blocks)] = blocks
+        slot.length = prompt.size
+        slot.generated = [int(first_token)]
+        slot.emitted_prefix = list(emitted_prefix)
+        slot.last_token_at = last_token_at
+        slot.prompt = prompt
+        slot.plan = []
+        slot.max_new = max_new
+        slot.temperature = temperature
+        slot.first_key = np.zeros((2,), np.uint32)  # consumed upstream
+        slot.step_keys = np.asarray(step_keys, np.uint32).reshape(-1, 2)
+        slot.result = result
+        self._results[rid] = result
+        if ec.speculative:
+            slot.drafter = NGramDrafter(ec.draft_ngram, prompt)
+            if hint:
+                slot.drafter.hint(hint)
+            slot.drafter.extend([int(first_token)])
+            slot.draft_width = ec.draft_len
+            slot.accept_rate = 0.5
+        self.peak_blocks_in_use = max(
+            self.peak_blocks_in_use, self.allocator.blocks_in_use)
+        return True
 
     def step(self) -> bool:
         """One scheduling iteration: admit what fits, consume the
@@ -953,6 +1121,8 @@ class ServingEngine:
         # short pool folds the over-wide buckets into one (possibly
         # non-power-of-two) max_request_len-wide shape
         widths = {min(w, ec.max_request_len) for w in widths}
+        if ec.pool_role == "decode":
+            widths = set()  # no prefill shape ever dispatches here
         s = ec.num_slots
         one = jnp.zeros((1,), jnp.int32)
         zeros_s = jnp.zeros((s,), jnp.int32)
@@ -1001,14 +1171,15 @@ class ServingEngine:
                             jnp.zeros((s,), jnp.float32),
                             jnp.zeros((s, 1 + k, 2), jnp.uint32))
                         self.pool = replace(self.pool, k=pk, v=pv)
-        _, pk, pv = self._decode_step(
-            self.params, self.pool.k, self.pool.v,
-            jnp.zeros((s, self._table_width), jnp.int32),
-            zeros_s, jnp.zeros((s,), bool), zeros_s,
-            jnp.zeros((s,), jnp.float32),
-            jnp.zeros((s, ec.decode_span, 2), jnp.uint32), zeros_s)
-        self.pool = replace(self.pool, k=pk, v=pv)
-        if ec.speculative:
+        if ec.pool_role != "prefill":
+            _, pk, pv = self._decode_step(
+                self.params, self.pool.k, self.pool.v,
+                jnp.zeros((s, self._table_width), jnp.int32),
+                zeros_s, jnp.zeros((s,), bool), zeros_s,
+                jnp.zeros((s,), jnp.float32),
+                jnp.zeros((s, ec.decode_span, 2), jnp.uint32), zeros_s)
+            self.pool = replace(self.pool, k=pk, v=pv)
+        if ec.speculative and ec.pool_role != "prefill":
             # verify widths are 1 + pow2(max draft) with the adaptive
             # controller confined to power-of-two widths <= draft_len,
             # so this small set is exhaustive
@@ -1022,14 +1193,18 @@ class ServingEngine:
                     jnp.zeros((s,), jnp.float32),
                     jnp.zeros((s, 1 + k, 2), jnp.uint32))
                 self.pool = replace(self.pool, k=pk, v=pv)
-        if self.prefix_index is not None:
+        if self.prefix_index is not None and ec.pool_role != "decode":
             # the CoW copy's one shape; scratch -> scratch is a no-op
+            # (a decode-role pool never admits through the prefix
+            # matcher, so divergence copies cannot occur there)
             zero = jnp.zeros((), jnp.int32)
             pk, pv = self._copy_step(self.pool.k, self.pool.v, zero, zero)
             self.pool = replace(self.pool, k=pk, v=pv)
-        if self.host_tier is not None:
-            # the tier's one upload shape: a zero slab into the scratch
-            # block (whose rows are dead by construction)
+        if self.host_tier is not None or ec.pool_role == "decode":
+            # the ONE upload shape tier promotions AND migration
+            # unpacks share (a decode pool needs it even with tiering
+            # off): a zero slab into the scratch block (whose rows are
+            # dead by construction)
             cfg2 = self.model_config
             slab = jnp.zeros((cfg2.n_layers, cfg2.kv_heads, ec.block_size,
                               cfg2.head_dim), cfg2.dtype)
@@ -1077,22 +1252,27 @@ class ServingEngine:
             "kubeshare_serving_tokens_generated_total",
             "Tokens emitted across all requests.", "counter")
         tokens.add({}, self.tokens_generated)
+        # disaggregated pools tag their latency/dispatch families with
+        # a `pool` label; monolithic engines add NO label, so every
+        # existing exact-label-match consumer is untouched
+        plabel = {"pool": self.pool_label} if self.pool_label else {}
         dispatches = MetricFamily(
             "kubeshare_serving_dispatches_total",
             "Device dispatches by kind (mixed = one fused prefill "
             "chunk + decode span, mixed_verify = prefill chunk + "
             "verify chunk; the standalone kinds exclude fused work).",
             "counter")
-        dispatches.add({"kind": "prefill_chunk"},
+        dispatches.add({"kind": "prefill_chunk", **plabel},
                        self.prefill_chunks - self.mixed_steps
                        - self.mixed_verify_steps)
-        dispatches.add({"kind": "decode_span"},
+        dispatches.add({"kind": "decode_span", **plabel},
                        self.decode_steps - self.mixed_steps)
-        dispatches.add({"kind": "mixed"}, self.mixed_steps)
-        dispatches.add({"kind": "verify_span"},
+        dispatches.add({"kind": "mixed", **plabel}, self.mixed_steps)
+        dispatches.add({"kind": "verify_span", **plabel},
                        self.verify_steps - self.mixed_verify_steps)
-        dispatches.add({"kind": "mixed_verify"}, self.mixed_verify_steps)
-        dispatches.add({"kind": "cow_copy"}, self.cow_copies)
+        dispatches.add({"kind": "mixed_verify", **plabel},
+                       self.mixed_verify_steps)
+        dispatches.add({"kind": "cow_copy", **plabel}, self.cow_copies)
         prefix = MetricFamily(
             "kubeshare_serving_prefix_cache_requests_total",
             "Admitted requests by prefix-cache outcome.", "counter")
@@ -1158,8 +1338,9 @@ class ServingEngine:
             "kubeshare_serving_ttft_seconds",
             "Time to first token (submit to first emitted token).",
             "histogram")
-        _histogram_samples(ttft, "kubeshare_serving_ttft_seconds", {},
-                           self._ttft_counts, self._ttft_sum)
+        _histogram_samples(ttft, "kubeshare_serving_ttft_seconds",
+                           dict(plabel), self._ttft_counts,
+                           self._ttft_sum)
         # ---- per-tenant QoS families ------------------------------------
         t_depth = MetricFamily(
             "kubeshare_serving_tenant_queue_depth",
@@ -1190,7 +1371,7 @@ class ServingEngine:
         for cls, (counts, total) in sorted(self._ttft_class.items()):
             _histogram_samples(
                 cls_ttft, "kubeshare_serving_ttft_by_class_seconds",
-                {"qos": cls}, counts, total)
+                {"qos": cls, **plabel}, counts, total)
         tbt = MetricFamily(
             "kubeshare_serving_tbt_seconds",
             "Inter-token latency by QoS class: wall time between "
@@ -1200,7 +1381,7 @@ class ServingEngine:
         for cls, (counts, total) in sorted(self._tbt_class.items()):
             _histogram_samples(
                 tbt, "kubeshare_serving_tbt_seconds",
-                {"qos": cls}, counts, total, TBT_BUCKETS)
+                {"qos": cls, **plabel}, counts, total, TBT_BUCKETS)
         spec_tokens = MetricFamily(
             "kubeshare_serving_spec_tokens_total",
             "Speculative decoding volume per tenant: drafted = "
@@ -1319,8 +1500,8 @@ class ServingEngine:
             node = stack.pop()
             # under the allocator lock: read the charge ledger directly
             tenant = self.allocator._tenant_of.get(node.block)
-            key = self.host_tier.put(self._read_block_payload(node),
-                                     tenant, node)
+            payload = self._read_block_payload(node)
+            key = self.host_tier.put(payload, tenant, node)
             if key is None:
                 device, host_keys = self.prefix_index.detach(node)
                 for hk in host_keys:
@@ -1335,6 +1516,11 @@ class ServingEngine:
             self.prefix_index.demote(node.block, key)
             self.tier_demoted_blocks += 1
             self.evictions_by_reason["tier_demote"] += 1
+            if self.on_tier_demote is not None:
+                # disagg cross-pool cache bus: mirror the payload into
+                # the PEER pool's trie (pure host work — safe under the
+                # allocator lock; the router never touches THIS pool)
+                self.on_tier_demote(node, payload, tenant)
             stack.extend(
                 child
                 for child in list(node.children.values()) + node.partials
@@ -1389,7 +1575,8 @@ class ServingEngine:
                 host_cow = tail
         plan, cover = plan_prefill_chunks(
             prompt.size, ec.prefill_chunk, ec.max_request_len, matched)
-        total_rows = max(cover, prompt.size + pending.max_new)
+        total_rows = self._lifetime_rows(prompt.size, pending.max_new,
+                                         cover)
         needed = (self.allocator.blocks_for_tokens(total_rows)
                   - len(shared))
         host_tokens = (len(promote) * ec.block_size
@@ -1415,6 +1602,9 @@ class ServingEngine:
         needs.  A partially matched tail block is copied-on-write into
         the first fresh block before the slot may append to it."""
         while True:
+            if self.admission_gate is not None \
+                    and not self.admission_gate():
+                return
             order = self._queue.order()
             if not order:
                 return
@@ -1588,6 +1778,9 @@ class ServingEngine:
                     jnp.asarray(blocks[n_promote], jnp.int32),
                     jnp.asarray(k_slab), jnp.asarray(v_slab))
                 self.pool = replace(self.pool, k=pk, v=pv)
+                # peek leaves the entry host-side, so take()'s promote
+                # metering never sees this copy-out — meter it here
+                self.host_tier.meter(entry.nbytes, "promote")
             self.tier_promoted_blocks += n_promote + (
                 1 if hit.host_cow is not None else 0)
             self.tier_promotion_stall_s += time.monotonic() - t0
@@ -1737,13 +1930,20 @@ class ServingEngine:
         else:
             first_key = np.zeros((2,), np.uint32)
             step_keys = np.zeros((0, 2), np.uint32)
-        self._queue.requeue_front(slot.tenant, _Pending(
+        pending = _Pending(
             rid=slot.rid, tenant=slot.tenant, prompt=resume_prompt,
             max_new=remaining, temperature=slot.temperature,
             plan=plan, needed=needed, first_key=first_key,
             step_keys=step_keys,
             emitted=slot.emitted_prefix + slot.generated,
-            last_token_at=slot.last_token_at))
+            last_token_at=slot.last_token_at)
+        if self.on_preempt_requeue is not None:
+            # disagg: the resume must re-prefill, which happens in the
+            # PREFILL pool — the router re-plans the entry with that
+            # pool's geometry and requeues it there
+            self.on_preempt_requeue(slot.tenant, pending)
+        else:
+            self._queue.requeue_front(slot.tenant, pending)
         self.preemptions[slot.tenant] = \
             self.preemptions.get(slot.tenant, 0) + 1
         slot._clear()
@@ -2052,7 +2252,39 @@ class ServingEngine:
         if slot.drafter is not None:
             slot.drafter.extend([first])
         slot.state = "decode"
+        ec = self.engine_config
+        if (self.on_handoff is not None
+                and len(slot.generated) < slot.max_new
+                and not (ec.eos_token is not None and first == ec.eos_token)):
+            # disagg handoff: the request still has tokens to emit and
+            # this pool's role ends at prefill — the router packs the
+            # slot's chain and re-admits it into the decode pool.  A
+            # request already done (max_new == 1, or first token == EOS)
+            # retires here like any monolithic request.
+            self.on_handoff(slot)
+            self._retire_handoff(slot)
+            return
         self._maybe_retire(slot, first)
+
+    def _retire_handoff(self, slot: _Slot) -> None:
+        """Free a slot whose request just migrated out: index the
+        prompt blocks (exactly :meth:`_maybe_retire`'s trie insert —
+        the NEXT prompt sharing this prefix hits in THIS pool, where
+        prefill happens), reclaim the chain, clear the slot.  The
+        request is NOT finished: no finished_at, no requests_finished
+        — the decode pool emits the rest and the router merges the
+        counters without double-counting."""
+        if self.prefix_index is not None:
+            n_prompt = self.allocator.blocks_for_tokens(slot.prompt.size)
+            prompt_blocks = [int(b) for b in slot.table[:n_prompt]]
+            newly_cached, displaced = self.prefix_index.insert(
+                slot.prompt, prompt_blocks)
+            self.allocator.mark_cached(newly_cached)
+            for b in displaced:
+                self.allocator.uncache(b)
+        self.allocator.reclaim(slot.blocks[::-1])
+        slot._clear()
+        slot.state = "free"
 
     def _accept_decode(self, decode_slots: List[_Slot],
                        emitted: np.ndarray, budgets: np.ndarray) -> None:

@@ -28,9 +28,10 @@ from __future__ import annotations
 import threading
 from collections import OrderedDict
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional, Sequence, Set
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..models.transformer import TransformerConfig
 
@@ -73,6 +74,49 @@ class PagedKVPool:
         granularity the serving docs size against."""
         n_layers, _, kv_heads, block_size, head_dim = self.k.shape
         return 2 * n_layers * kv_heads * block_size * head_dim * self.k.dtype.itemsize
+
+    def read_block(self, block: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Host snapshot of one block's K and V slabs, each
+        ``[n_layers, kv_heads, block_size, head_dim]`` — the export
+        half of KV migration (the pack side feeds these straight into
+        ``kv_tier.pack_block``).  Reading synchronizes with any
+        in-flight dispatch writing the pool; callers on the pipelined
+        hot path meter that stall."""
+        return (np.asarray(self.k[:, block]), np.asarray(self.v[:, block]))
+
+    def read_chain(
+        self, blocks: Sequence[int], pad_to: Optional[int] = None
+    ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """Host snapshot of a whole block chain in ONE gather + ONE
+        device-to-host transfer per tensor — per-block (K, V) slab
+        pairs shaped like :meth:`read_block`'s.  The migration pack
+        walks entire chains, and a per-block read would pay one
+        pool-write sync per block; here the chain pays it once.
+        ``pad_to`` (e.g. the slot table width) fixes the gather's index
+        shape so it compiles ONCE instead of once per chain length —
+        the padding rows re-read block 0 and are dropped host-side."""
+        idx = list(blocks)
+        n = len(idx)
+        if pad_to is not None and pad_to > n:
+            idx = idx + [0] * (pad_to - n)
+        gather = jnp.asarray(idx, jnp.int32)
+        k_all = np.asarray(self.k[:, gather])  # [n_layers, n, heads, bs, hd]
+        v_all = np.asarray(self.v[:, gather])
+        return [(k_all[:, i], v_all[:, i]) for i in range(n)]
+
+
+def chain_token_runs(tokens, block_size: int) -> List[List[int]]:
+    """Split a token sequence into per-block runs: run ``i`` holds the
+    tokens whose K/V rows live in the chain's ``i``-th block (the last
+    run may be partial).  The migration pack walks a slot's table with
+    exactly these runs — one ``pack_block`` frame per block."""
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    toks = [int(t) for t in tokens]
+    if not toks:
+        raise ValueError("cannot split an empty token sequence")
+    return [toks[i: i + block_size]
+            for i in range(0, len(toks), block_size)]
 
 
 def init_paged_pool(

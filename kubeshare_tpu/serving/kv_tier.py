@@ -59,6 +59,16 @@ import numpy as np
 # loudly before trusting a single field.
 KV_WIRE_MAGIC = b"KVWB"
 KV_WIRE_VERSION = 1
+# Chain container (disaggregated prefill/decode migration unit,
+# serving/disagg.py): a counted sequence of length-prefixed pack_block
+# frames — one slot's whole block chain in one buffer.  Versioned
+# separately from the block format: a chain receiver validates the
+# envelope first, then each frame through unpack_block's own checks.
+KV_CHAIN_MAGIC = b"KVCH"
+KV_CHAIN_VERSION = 1
+# magic, version, reserved, frame count
+_CHAIN_HEADER = struct.Struct("<4sHHI")
+_FRAME_LEN = struct.Struct("<I")
 # magic, version, header_len, n_layers, kv_heads, block_size, head_dim,
 # n_tokens, reserved, dtype NAME (ascii, NUL-padded).  The name (not
 # numpy's ``.str`` tag) is deliberate: extension dtypes like bfloat16
@@ -148,6 +158,62 @@ def unpack_block(buf: bytes) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     off += count * dtype.itemsize
     v = np.frombuffer(buf, dtype, count, off).reshape(slab).copy()
     return tokens, k, v
+
+
+def pack_chain(frames) -> bytes:
+    """Serialize a slot's whole block chain: a counted envelope of
+    length-prefixed :func:`pack_block` frames, in table order (frame i
+    holds rows ``i*block_size ..``).  This is the KV-migration unit the
+    disaggregated engine ships from the prefill pool to the decode pool
+    (serving/disagg.py) — and, later, across hosts."""
+    frames = list(frames)
+    if not frames:
+        raise ValueError("a chain must carry at least one block frame")
+    parts = [_CHAIN_HEADER.pack(KV_CHAIN_MAGIC, KV_CHAIN_VERSION, 0,
+                                len(frames))]
+    for frame in frames:
+        if not isinstance(frame, (bytes, bytearray)):
+            raise ValueError(
+                f"chain frames must be bytes, got {type(frame).__name__}")
+        parts.append(_FRAME_LEN.pack(len(frame)))
+        parts.append(bytes(frame))
+    return b"".join(parts)
+
+
+def unpack_chain(buf: bytes) -> List[bytes]:
+    """Inverse of :func:`pack_chain`: the block frames, in chain order.
+    Frames come back as raw bytes — each still carries its own
+    :func:`pack_block` header, so the receiver's :func:`unpack_block`
+    re-validates every block independently."""
+    if len(buf) < _CHAIN_HEADER.size:
+        raise ValueError(f"wire chain truncated at {len(buf)} bytes")
+    magic, version, _reserved, count = _CHAIN_HEADER.unpack_from(buf)
+    if magic != KV_CHAIN_MAGIC:
+        raise ValueError(f"bad chain magic {magic!r}")
+    if version != KV_CHAIN_VERSION:
+        raise ValueError(
+            f"chain version {version} unsupported (this build speaks "
+            f"{KV_CHAIN_VERSION})")
+    if count < 1:
+        raise ValueError("wire chain carries zero frames")
+    frames: List[bytes] = []
+    off = _CHAIN_HEADER.size
+    for _ in range(count):
+        if off + _FRAME_LEN.size > len(buf):
+            raise ValueError(
+                f"wire chain truncated mid-frame at {off} bytes")
+        (n,) = _FRAME_LEN.unpack_from(buf, off)
+        off += _FRAME_LEN.size
+        if off + n > len(buf):
+            raise ValueError(
+                f"chain frame of {n} bytes overruns the {len(buf)}-byte "
+                f"buffer at offset {off}")
+        frames.append(buf[off: off + n])
+        off += n
+    if off != len(buf):
+        raise ValueError(
+            f"wire chain carries {len(buf) - off} trailing bytes")
+    return frames
 
 
 class HostEntry:
@@ -258,7 +324,8 @@ class HostTier:
     below make that reentrant cascade safe."""
 
     def __init__(self, budget_bytes: int, policy: TierPolicy,
-                 on_drop: Optional[Callable[[HostEntry], None]] = None
+                 on_drop: Optional[Callable[[HostEntry], None]] = None,
+                 ledger_hook: Optional[Callable[[int, str], None]] = None
                  ) -> None:
         if budget_bytes < 1:
             raise ValueError(
@@ -266,6 +333,14 @@ class HostTier:
         self.budget_bytes = budget_bytes
         self.policy = policy
         self.on_drop = on_drop
+        # byte-accounting tap, ``hook(nbytes, kind)`` with kind in
+        # {"demote", "promote", "migrate"}: on real hardware tier and
+        # migration traffic moves through PJRT transfers the interposer
+        # meters at Buffer_CopyToDevice — this hook lets the serving
+        # plane report the same bytes to fractional-HBM accounting
+        # (e.g. TokenClient.request_memory's MEM verb, the exact ledger
+        # the interposer charges).  None = no accounting.
+        self.ledger_hook = ledger_hook
         self._entries: "OrderedDict[int, HostEntry]" = OrderedDict()
         self._pinned: Set[int] = set()
         self._next_key = 0
@@ -330,7 +405,22 @@ class HostTier:
         self.used_bytes += need
         self.peak_bytes = max(self.peak_bytes, self.used_bytes)
         self.stored_blocks += 1
+        self.meter(need, "demote")
         return key
+
+    def bind_node(self, key: int, node) -> None:
+        """Point an entry at its trie node after the fact — the
+        cross-pool mirror path (serving/disagg.py) must insert the
+        payload BEFORE it can attach the peer index's node."""
+        self._entries[key].node = node
+
+    def meter(self, nbytes: int, kind: str) -> None:
+        """Report ``nbytes`` of tier/migration traffic to the ledger
+        hook (no-op unhooked).  Callers that move payload bytes outside
+        put/take — the engine's partial-match peek upload, the
+        migrator's chain delivery — account through here."""
+        if self.ledger_hook is not None:
+            self.ledger_hook(nbytes, kind)
 
     def peek(self, key: int) -> HostEntry:
         """Read an entry WITHOUT removing it (a partial host match
@@ -346,6 +436,7 @@ class HostTier:
         entry = self._entries.pop(key)
         self.used_bytes -= entry.nbytes
         self._pinned.discard(key)
+        self.meter(entry.nbytes, "promote")
         return entry
 
     def forget(self, key: int) -> bool:

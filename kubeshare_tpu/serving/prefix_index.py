@@ -398,6 +398,73 @@ class PrefixIndex:
             stack.extend(n.partials)
         return device, host_keys
 
+    # ------------------------------------------------------------------
+    def owns(self, node: _Node) -> bool:
+        """Whether ``node`` hangs off THIS index's root — the disagg
+        router's shared-tier drop callback must ask which pool's trie
+        an entry's node lives in before detaching it."""
+        while node.parent is not None:
+            node = node.parent
+        return node is self._root
+
+    def path_tokens(self, node: _Node) -> List[int]:
+        """The full root-to-``node`` token sequence — what a peer index
+        needs to re-home a mirrored host entry under its own trie
+        (:meth:`adopt_host`)."""
+        runs: List[Tuple[int, ...]] = []
+        while node is not None and node.parent is not None:
+            runs.append(node.tokens)
+            node = node.parent
+        return [int(t) for run in reversed(runs) for t in run]
+
+    def adopt_host(self, tokens, host_key: int) -> Optional[_Node]:
+        """Attach a HOST-resident node spelling ``tokens`` (the final
+        run only; everything before it must already be in the trie as
+        full-block ancestors).  The disagg cross-pool cache bus calls
+        this when the PEER pool demotes a block: the shared tier now
+        holds the payload, and adopting it here makes the prefix
+        promotable by THIS pool too.  Returns the new node, or None
+        when the adoption is impossible (a missing ancestor — host-ness
+        must stay downward-closed) or redundant (this trie already
+        covers the run, device- or host-resident).  The caller binds
+        the tier entry to the returned node (or forgets the mirrored
+        payload on None)."""
+        bs = self.block_size
+        toks = [int(t) for t in tokens]
+        if not toks:
+            return None
+        n_full_anc = (len(toks) - 1) // bs
+        node = self._root
+        for i in range(n_full_anc):
+            child = node.children.get(tuple(toks[i * bs: (i + 1) * bs]))
+            if child is None:
+                return None
+            node = child
+        seg = tuple(toks[n_full_anc * bs:])
+        if len(seg) == bs:
+            if seg in node.children:
+                return None
+            # insert() UPGRADES a partial leaf a full block extends;
+            # adoption declines instead of creating a competing sibling
+            for p in node.partials:
+                if seg[: len(p.tokens)] == p.tokens:
+                    return None
+            child = _Node(seg, -1, node)
+            child.host_key = host_key
+            node.children[seg] = child
+            return child
+        # partial tail: refuse when ANY existing child/partial overlaps
+        # (prefix either way) — match()/insert() longest-lcp rules would
+        # otherwise see two nodes competing for the same rows.
+        for c in list(node.children.values()) + node.partials:
+            k = min(len(c.tokens), len(seg))
+            if tuple(c.tokens[:k]) == seg[:k]:
+                return None
+        child = _Node(seg, -1, node)
+        child.host_key = host_key
+        node.partials.append(child)
+        return child
+
     def evict(self, block: int) -> List[int]:
         """Detach the node holding ``block`` plus its whole subtree;
         returns every DEVICE block id released (host-resident

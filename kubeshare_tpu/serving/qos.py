@@ -151,6 +151,23 @@ class TenantRegistry:
         return [n for n, s in sorted(self._specs.items())
                 if not s.is_guarantee]
 
+    def pool_view(self, fraction: float) -> "TenantRegistry":
+        """A per-pool view for disaggregated serving: the same tenants,
+        classes, and weights, with each KV block quota scaled to this
+        pool's share of total KV HBM (``ceil(quota * fraction)`` — a
+        tenant with ANY quota keeps one in every pool; uncapped stays
+        uncapped).  The prefill and decode pools each get one, so a
+        tenant's aggregate quota across pools tracks its monolithic
+        contract."""
+        if not (0.0 < fraction <= 1.0):
+            raise ValueError(
+                f"fraction must be in (0, 1], got {fraction}")
+        return TenantRegistry([
+            TenantSpec(s.name, s.qos_class, s.weight,
+                       None if s.kv_block_quota is None
+                       else max(1, math.ceil(s.kv_block_quota * fraction)))
+            for s in self.specs()])
+
 
 class _TenantLane:
     __slots__ = ("items", "service", "last_decay")

@@ -488,6 +488,65 @@ class TestGuard:
         assert apply_hbm_cap({"TPUSHARE_MEM_FRACTION": "2.0"}) is None
 
 
+class TestServingLedgerWiring:
+    """The serving plane's transfer-byte hook -> tokend MEM verb: every
+    KV byte the disaggregated engine stages host-side (tier demotes,
+    promotions, prefill->decode chain migrations) can be charged through
+    ``TokenClient.request_memory`` — the same fractional-HBM ledger the
+    LD_PRELOAD shim debits for ``PJRT_Buffer_CopyToDevice``, so a pod's
+    cache-tier traffic is accounted like any other device copy."""
+
+    def test_disagg_ledger_hook_charges_and_credits_broker(self, tokend):
+        import json
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kubeshare_tpu.models.transformer import (TransformerConfig,
+                                                      transformer_init)
+        from kubeshare_tpu.serving import DisaggRouter, EngineConfig, Request
+
+        client = TokenClient("127.0.0.1", tokend["port"], "ns/pod-a")
+        moved = []
+
+        def hook(nbytes, kind):
+            # charge the staging copy, credit it once landed — the
+            # transient CopyToDevice shape; a persistent-cache policy
+            # would keep the charge until the tier entry dies
+            ok, used, cap = client.request_memory(nbytes)
+            assert ok, (kind, nbytes, used, cap)
+            ok, _, _ = client.request_memory(-nbytes)
+            assert ok
+            moved.append((kind, nbytes))
+
+        config = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq_len=64, dtype=jnp.float32, attention="reference")
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        router = DisaggRouter(
+            params, config,
+            EngineConfig(num_slots=2, block_size=4, num_blocks=17,
+                         max_request_len=48, prefill_chunk=8, mixed=False),
+            EngineConfig(num_slots=2, block_size=4, num_blocks=13,
+                         max_request_len=48, prefill_chunk=8, mixed=False),
+            shared_tier_bytes=1 << 20, ledger_hook=hook)
+        router.warmup()
+        rng = np.random.default_rng(3)
+        for i in range(4):
+            router.submit(Request(
+                f"r{i}", rng.integers(0, 64, 12).astype(np.int32), 6))
+        router.run()
+        kinds = {k for k, _ in moved}
+        assert "migrate" in kinds and "demote" in kinds
+        assert sum(n for k, n in moved if k == "migrate") \
+            == router.migrator.migrated_bytes
+        # every charge was credited: the broker ledger is back to zero
+        stat = json.loads(client.stat())["pods"]["ns/pod-a"]
+        assert stat["mem_used"] == 0
+        client.close()
+
+
 class TestInterposer:
     """LD_PRELOAD path: a driver dlopens a fake PJRT plugin the way JAX
     loads libtpu; libtpushim must gate every Execute through the tokend."""
