@@ -86,8 +86,10 @@ Run:
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --tiered --smoke
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --disagg
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --disagg --smoke
+    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --sharded
+    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --sharded --smoke
     make serve-smoke serve-prefix-smoke serve-qos-smoke serve-mixed-smoke \
-         serve-tier-smoke serve-disagg-smoke
+         serve-tier-smoke serve-disagg-smoke serve-sharded-smoke
 
 - ``--disagg`` switches to the DISAGGREGATED PREFILL/DECODE
   comparison: the long-prefill/steady-decode adversarial trace
@@ -107,6 +109,22 @@ Run:
   is how much of the big pool's skipped-token rate the host tier
   recovers on the small pool (hit-rate, not HBM, setting the ceiling),
   with every stream hard-asserted identical across all arms.
+
+- ``--sharded`` switches to the TENSOR-PARALLEL comparison: the
+  long-prompt/decode-mix trace replayed through a tp-way sharded
+  engine (``EngineConfig.mesh_spec``; Megatron-split params, a
+  head-sharded paged KV pool, long prefill chunks routed through the
+  Ulysses re-shard) vs the single-device engine at equal PER-DEVICE
+  KV-HBM budget — the head-sharded pool stores ``kv_heads/tp`` of
+  every block per device, so the sharded arm funds ``tp x`` the
+  allocatable blocks at the same per-device bytes (asserted).
+  ABA-bracketed, every stream hard-asserted identical, zero
+  recompiles after warmup in both arms.  On the forced host-CPU mesh
+  (``--xla_force_host_platform_device_count=4``) the collectives are
+  memcpys over one physical core set and per-device FLOPs do not
+  shrink, so the tokens/s ratio is PROVENANCE, not a headline —
+  dispatch counts, collective-bytes estimates, and the tp-x KV
+  capacity are the portable numbers (docs/perf.md).
 """
 
 from __future__ import annotations
@@ -426,6 +444,56 @@ def tiered_settings() -> dict:
     )
 
 
+def sharded_smoke_settings() -> dict:
+    """Seconds-fast tensor-parallel path (CI, tests/test_serving.py):
+    the long-prompt/decode-mix trace shape on a 1-layer MHA model
+    whose 4 KV heads split one-per-device across the tp=4 host-CPU
+    mesh (the bench locks the HEAD-SHARDED pool — the replicated-KV
+    fallback is test coverage, not a capacity story).
+    ``long_context_threshold == prefill_chunk`` routes every full
+    prefill chunk through the Ulysses re-shard, so both attention
+    layouts (sequence-sharded chunk attention and head-local decode)
+    are exercised on one trace."""
+    return dict(
+        d_model=128, n_layers=1, n_heads=4, n_kv_heads=4, d_ff=256,
+        vocab_size=512, max_seq_len=192,
+        num_requests=14,
+        num_slots=4, block_size=8, num_blocks=41,   # 40 allocatable
+        tp=4, long_context_threshold=16,
+        max_request_len=160, prefill_chunk=16,
+        short_prompt_lo=8, short_prompt_hi=24,
+        short_new_lo=16, short_new_hi=32,
+        long_fraction=0.25, long_prompt_lo=64, long_prompt_hi=120,
+        long_new_lo=4, long_new_hi=12,
+        mean_interarrival_s=0.02, seed=0,
+    )
+
+
+def sharded_settings() -> dict:
+    """The tensor-parallel capture configuration (acceptance shape):
+    the full-bench GQA model (8 query / 4 KV heads — two query heads
+    per device attend their OWN device's KV shard) on the
+    long-prompt/decode-mix trace, tp=4.  One in eight requests brings
+    a multi-chunk ingest prompt whose full 64-token chunks cross
+    ``long_context_threshold`` and route through Ulysses.  KV budget:
+    the single-device arm's 120 allocatable blocks become 480 in the
+    sharded arm at the SAME per-device bytes — the capacity win
+    head-sharding exists for."""
+    return dict(
+        d_model=256, n_layers=4, n_heads=8, n_kv_heads=4, d_ff=1024,
+        vocab_size=4096, max_seq_len=384,
+        num_requests=64,
+        num_slots=6, block_size=16, num_blocks=121,  # 120 allocatable
+        tp=4, long_context_threshold=64,
+        max_request_len=320, prefill_chunk=64, decode_span=2,
+        short_prompt_lo=16, short_prompt_hi=48,
+        short_new_lo=64, short_new_hi=96,
+        long_fraction=0.125, long_prompt_lo=192, long_prompt_hi=288,
+        long_new_lo=8, long_new_hi=16,
+        mean_interarrival_s=0.05, seed=0,
+    )
+
+
 def build_tiered_workload(s: dict):
     """Many-distinct-shared-prefixes trace: every request opens with
     one of ``num_prefixes`` common ``prefix_len``-token prefixes
@@ -655,6 +723,18 @@ def _percentiles(values, ps=(50, 95)):
     return {f"p{p}": float(np.percentile(np.asarray(values), p)) for p in ps}
 
 
+def _metric_value(metric: dict, name: str, **want):
+    """Sum one family's samples whose labels INCLUDE ``want``.
+    Constant labels (``pool`` on disagg engines, ``tp`` on sharded
+    ones) ride along on the dispatch/latency families, so exact
+    label-tuple lookups break the moment an arm adds one — subset
+    matching reads the same series everywhere."""
+    return sum(
+        v for (n, labels), v in metric.items()
+        if n == name
+        and all(dict(labels).get(k) == w for k, w in want.items()))
+
+
 def _metric_histogram(metric: dict, name: str):
     """Merge one promtext histogram family's ``_bucket`` series
     (across label sets, e.g. the per-QoS-class TBT series) into a
@@ -692,9 +772,14 @@ def run_continuous(params, config, s: dict, trace,
                    prefix_cache: bool = True, registry=None,
                    tenant_of=None, mixed: bool = True,
                    host_tier_bytes=None, num_blocks=None,
-                   speculative: bool = False) -> dict:
+                   speculative: bool = False, tp=None,
+                   long_context_threshold=None) -> dict:
     from kubeshare_tpu.serving import EngineConfig, Request, ServingEngine
 
+    mesh_spec = None
+    if tp:
+        from kubeshare_tpu.parallel.mesh import MeshSpec
+        mesh_spec = MeshSpec(dp=1, tp=tp, sp=1)
     engine = ServingEngine(params, config, EngineConfig(
         num_slots=s["num_slots"], block_size=s["block_size"],
         num_blocks=(num_blocks if num_blocks is not None
@@ -704,7 +789,9 @@ def run_continuous(params, config, s: dict, trace,
         mixed=mixed, decode_span=s.get("decode_span", 4),
         host_tier_bytes=host_tier_bytes,
         tier_policy=s.get("tier_policy", "lru"),
-        speculative=speculative, draft_len=s.get("draft_len", 8)),
+        speculative=speculative, draft_len=s.get("draft_len", 8),
+        mesh_spec=mesh_spec,
+        long_context_threshold=long_context_threshold),
         tenants=registry)
     engine.warmup()
     compiles_before = engine.compile_counts()
@@ -766,12 +853,11 @@ def run_continuous(params, config, s: dict, trace,
         "decode_steps": engine.decode_steps,
         "prefill_chunks": engine.prefill_chunks,
         "verify_steps": engine.verify_steps,
-        "mixed_steps": int(metric[
-            ("kubeshare_serving_dispatches_total",
-             (("kind", "mixed"),))]),
-        "mixed_verify_steps": int(metric[
-            ("kubeshare_serving_dispatches_total",
-             (("kind", "mixed_verify"),))]),
+        "mixed_steps": int(_metric_value(
+            metric, "kubeshare_serving_dispatches_total", kind="mixed")),
+        "mixed_verify_steps": int(_metric_value(
+            metric, "kubeshare_serving_dispatches_total",
+            kind="mixed_verify")),
         # target-model dispatches per emitted token (decode spans +
         # verify chunks; prefill is phase-independent) — speculation's
         # headline denominator
@@ -806,9 +892,16 @@ def run_continuous(params, config, s: dict, trace,
         "prefix_hit_requests": int(metric[
             ("kubeshare_serving_prefix_cache_requests_total",
              (("result", "hit"),))]),
-        "cow_copies": int(metric[
-            ("kubeshare_serving_dispatches_total",
-             (("kind", "cow_copy"),))]),
+        "cow_copies": int(_metric_value(
+            metric, "kubeshare_serving_dispatches_total",
+            kind="cow_copy")),
+        # sharded engines report their collective traffic estimate via
+        # the scrape surface; all-zero on a single-device engine
+        "collective_bytes": {
+            dict(labels)["kind"]: int(v)
+            for (name, labels), v in metric.items()
+            if name == "kubeshare_serving_collective_bytes_total"},
+        "warmup_compiles": {k: int(v) for k, v in compiles_before.items()},
         # the eviction family grew a `reason` label (tiering PR): sum
         # for the total, keep the per-reason split alongside
         "evicted_blocks": int(sum(
@@ -1478,6 +1571,111 @@ def run_tiered_bench(s: dict, aba: bool = True) -> dict:
     }
 
 
+def run_sharded_bench(s: dict, aba: bool = True) -> dict:
+    """Tensor-parallel sharded serving vs the single-device engine on
+    one long-prompt/decode-mix trace at equal PER-DEVICE KV-HBM
+    budget: the head-sharded pool stores ``kv_heads/tp`` of every
+    block per device, so at the same per-device bytes the tp-way arm
+    funds ``tp x`` the allocatable blocks
+    ((sharded_blocks-1) == tp * (mono_blocks-1) by construction).
+    The acceptance bar: every stream bit-exact across arms (greedy
+    mixed batching, full prefill chunks routed through the Ulysses
+    re-shard), zero recompiles after warmup in BOTH engines, and the
+    single-device arms' collective-bytes counters all zero.  On the
+    forced host-CPU mesh the collectives are memcpys over one
+    physical core set and per-device FLOPs do not shrink, so the
+    tokens/s ratio is recorded as provenance, not a headline —
+    dispatch counts, collective bytes, and the tp-x capacity are the
+    portable numbers.  ``aba=False`` drops the second bracketing
+    single-device run (tests lock mechanics, not timing)."""
+    tp = s["tp"]
+    if s["n_kv_heads"] < tp or s["n_kv_heads"] % tp:
+        raise ValueError(
+            f"the sharded bench locks the HEAD-SHARDED pool: "
+            f"n_kv_heads {s['n_kv_heads']} must be a multiple of "
+            f"tp={tp} (the replicated-KV fallback is test coverage, "
+            f"not a capacity comparison)")
+    config, params = _bench_model(s)
+    mono_blocks = s["num_blocks"] - 1
+    sharded_blocks = tp * mono_blocks  # same per-device KV bytes
+    trace, longs = build_mixed_workload(s)
+
+    # ABA bracket (docs/perf.md methodology): first-trace-run host
+    # costs bias whichever arm runs first, so the sharded run is
+    # bracketed by two single-device runs and compared to their mean;
+    # streams and dispatch counts are deterministic — only wall time
+    # drifts between A and B.
+    off_a = run_continuous(params, config, s, trace, mixed=True)
+    on = run_continuous(
+        params, config, s, trace, mixed=True, tp=tp,
+        num_blocks=sharded_blocks + 1,
+        long_context_threshold=s.get("long_context_threshold"))
+    off_b = (run_continuous(params, config, s, trace, mixed=True)
+             if aba else off_a)
+    recompiles = (on.pop("recompiles") + off_a.pop("recompiles")
+                  + (off_b.pop("recompiles") if aba else 0))
+    if recompiles:
+        raise RuntimeError(
+            f"{recompiles} recompilations after warmup — a static-shape "
+            f"leak; the comparison (and a TPU serving pod) is invalid")
+    # the tentpole's whole claim, end to end: sharding the model and
+    # the pool may not change a single token of any stream
+    mismatched = [
+        rid for rid in on["requests"]
+        if on["requests"][rid]["tokens"] != off_a["requests"][rid]["tokens"]
+        or on["requests"][rid]["tokens"] != off_b["requests"][rid]["tokens"]]
+    if mismatched:
+        raise RuntimeError(
+            f"streams diverged between sharded and single-device for "
+            f"{mismatched} — tensor-parallel execution is NOT bit-exact")
+    if any(off_a["collective_bytes"].values()):
+        raise RuntimeError(
+            "single-device arm charged collective bytes "
+            f"{off_a['collective_bytes']} — the estimate must be "
+            "all-zero off-mesh")
+    if not (on["collective_bytes"]["prefill_chunk"]
+            and on["collective_bytes"]["decode_span"]):
+        raise RuntimeError(
+            f"sharded arm charged no collective traffic "
+            f"{on['collective_bytes']} — the estimate is not wired "
+            f"through the dispatch path")
+    on.pop("requests")
+    off_a.pop("requests")
+    if aba:
+        off_b.pop("requests")
+    off_tps = (off_a["tokens_per_s"] + off_b["tokens_per_s"]) / 2
+    off_p50 = (off_a["tbt_s"]["p50"] + off_b["tbt_s"]["p50"]) / 2
+    off_p99 = (off_a["tbt_s"]["p99"] + off_b["tbt_s"]["p99"]) / 2
+    return {
+        "suite": "serving-sharded",
+        "metric": "tp-way sharded engine vs single-device (mean of "
+                  "the two bracketing runs) on the long-prompt/"
+                  "decode-mix trace at equal per-device KV-HBM "
+                  "budget; streams bit-exact; on a host-CPU mesh the "
+                  "tokens/s ratio is provenance — dispatch counts, "
+                  "collective bytes, and tp-x KV capacity are the "
+                  "portable numbers",
+        "settings": {k: v for k, v in s.items()},
+        "tp": tp,
+        "kv_blocks": {"single_device": mono_blocks,
+                      "sharded_total": sharded_blocks,
+                      "per_device_block_fraction": 1.0 / tp},
+        "long_requests": len(longs),
+        "sharded": on,
+        "single_first": off_a,
+        "single_last": off_b,
+        "single": {"tokens_per_s": off_tps,
+                   "tbt_s": {"p50": off_p50, "p99": off_p99},
+                   "mixed_steps": off_a["mixed_steps"]},
+        "tokens_per_s_ratio": on["tokens_per_s"] / max(1e-9, off_tps),
+        "collective_bytes": on["collective_bytes"],
+        "streams_bit_exact": True,
+        "recompiles_after_warmup": recompiles,
+        "devices": jax.device_count(),
+        "platform": jax.default_backend(),
+    }
+
+
 def _tenant_stats(requests: dict, trace, tenant_of, tenant: str) -> dict:
     """Per-tenant aggregates over one run's raw request records:
     tokens/s over the tenant's active span (first arrival to last
@@ -1611,9 +1809,21 @@ def main() -> None:
                         help="disaggregated prefill/decode pools vs the "
                              "monolithic mixed engine at equal total "
                              "KV-HBM budget (decode TBT p99 headline)")
+    parser.add_argument("--sharded", action="store_true",
+                        help="tensor-parallel sharded engine vs "
+                             "single-device at equal per-device KV "
+                             "budget (streams hard-asserted identical; "
+                             "dispatch/collective-bytes headline)")
     parser.add_argument("--json", help="write the result JSON here too")
     args = parser.parse_args()
-    if args.disagg and "host_platform_device_count" not in \
+    if args.sharded and "host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        # four virtual CPU devices to stand up the tp=4 serving mesh;
+        # the flag must land before the first backend use
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=4")
+    elif args.disagg and "host_platform_device_count" not in \
             os.environ.get("XLA_FLAGS", ""):
         # two virtual CPU devices so the pools' dispatches genuinely
         # overlap (virtual_multislice placement); the flag must land
@@ -1621,7 +1831,10 @@ def main() -> None:
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
             + " --xla_force_host_platform_device_count=2")
-    if args.disagg:
+    if args.sharded:
+        result = run_sharded_bench(
+            sharded_smoke_settings() if args.smoke else sharded_settings())
+    elif args.disagg:
         result = run_disagg_bench(
             disagg_smoke_settings() if args.smoke else disagg_settings())
     elif args.speculative:
@@ -1647,6 +1860,24 @@ def main() -> None:
     if args.json:
         with open(args.json, "w") as f:
             f.write(text + "\n")
+    if args.sharded:
+        on = result["sharded"]
+        coll = result["collective_bytes"]
+        kvb = result["kv_blocks"]
+        print(f"\ntensor-parallel serving (tp={result['tp']}, host-CPU "
+              f"mesh): {kvb['sharded_total']} allocatable KV blocks vs "
+              f"{kvb['single_device']} single-device at the SAME "
+              f"per-device bytes ({result['tp']}x capacity); tokens/s "
+              f"ratio {result['tokens_per_s_ratio']:.3f} (provenance "
+              f"only on CPU — collectives are memcpys, per-device "
+              f"FLOPs don't shrink); {on['prefill_chunks']} prefill "
+              f"chunks / {on['decode_steps']} decode spans / "
+              f"{on['mixed_steps']} fused dispatches; collective "
+              f"bytes prefill {coll['prefill_chunk']} / decode "
+              f"{coll['decode_span']} / verify {coll['verify_span']}; "
+              f"streams bit-exact; zero recompiles after warmup",
+              file=sys.stderr)
+        return
     if args.disagg:
         on, off = result["disagg"], result["monolithic"]
         mig = on["migration"]

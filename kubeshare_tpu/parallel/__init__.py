@@ -1,4 +1,5 @@
-from .mesh import MeshSpec, make_mesh, batch_sharding, replicated, shard_params
+from .mesh import (MeshSpec, make_mesh, batch_sharding, replicated,
+                   serving_mesh, shard_params)
 from .train import TrainState, cross_entropy_loss, make_train_step
 from .pipeline import pipeline_apply, stack_stage_params
 from .checkpoint import latest_checkpoint, restore_checkpoint, save_checkpoint
@@ -8,6 +9,7 @@ __all__ = [
     "make_mesh",
     "batch_sharding",
     "replicated",
+    "serving_mesh",
     "shard_params",
     "TrainState",
     "cross_entropy_loss",

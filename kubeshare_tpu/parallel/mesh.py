@@ -45,20 +45,48 @@ class MeshSpec:
     sp: int = 1
 
     def resolve(self, n_devices: int) -> Tuple[int, int, int, int]:
+        """Resolve to concrete (dp, ep, tp, sp); every degenerate spec
+        fails LOUDLY here instead of surfacing as a cryptic reshape
+        error (or a ZeroDivisionError) inside ``make_mesh``:
+
+        - an axis must be -1 (fill) or >= 1 — 0 / negative axes are
+          meaningless and used to divide-by-zero;
+        - at most ONE axis may be -1 — the old code substituted the
+          same fill into EVERY -1, so the axis product silently stopped
+          matching the device count;
+        - the resolved product must equal ``n_devices`` exactly — an
+          over-subscribed spec (product > devices) and an
+          under-subscribed one (product < devices) both raise.
+        """
+        if n_devices < 1:
+            raise ValueError(f"n_devices must be >= 1, got {n_devices}")
         axes = (self.dp, self.ep, self.tp, self.sp)
+        names = ("dp", "ep", "tp", "sp")
+        for name, d in zip(names, axes):
+            if d != -1 and d < 1:
+                raise ValueError(
+                    f"mesh axis {name}={d} is degenerate — every axis "
+                    f"must be -1 (absorb remaining devices) or >= 1")
+        fills = sum(1 for d in axes if d == -1)
+        if fills > 1:
+            raise ValueError(
+                f"mesh {self} has {fills} fill (-1) axes — the fill is "
+                f"ambiguous; at most one axis may be -1")
         known = [d for d in axes if d != -1]
         prod = int(np.prod(known)) if known else 1
-        if -1 in axes:
+        if fills:
             if n_devices % prod != 0:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by fixed axes {prod}"
+                    f"mesh {self}: fixed axes need a multiple of {prod} "
+                    f"devices, but {n_devices} are available"
                 )
             fill = n_devices // prod
         else:
             fill = None
             if prod != n_devices:
                 raise ValueError(
-                    f"mesh {self})={prod} devices != available {n_devices}"
+                    f"mesh {self} spans {prod} devices != available "
+                    f"{n_devices}"
                 )
         dims = tuple((fill if d == -1 else d) for d in axes)
         return dims  # type: ignore[return-value]
@@ -75,6 +103,30 @@ def make_mesh(
         return Mesh(array, ("dp", "ep", "tp", "sp"))
     array = np.array(devices).reshape(dp, tp, sp)
     return Mesh(array, ("dp", "tp", "sp"))
+
+
+def serving_mesh(
+    tp: int,
+    dp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """The serving engine's mesh preset: ``tp``-way tensor parallelism
+    (heads/features split inside each dispatch, collectives inside the
+    compiled program), optional ``dp`` replica groups for a fleet
+    front-end.  Uses the leading ``dp * tp`` devices so a host with
+    more devices than the serving pod needs (e.g. the forced 8-device
+    CPU test mesh) still builds the exact requested shape instead of
+    failing the strict :meth:`MeshSpec.resolve` product check."""
+    if tp < 1 or dp < 1:
+        raise ValueError(
+            f"serving_mesh needs tp >= 1 and dp >= 1, got tp={tp} dp={dp}")
+    need = dp * tp
+    avail = list(devices if devices is not None else jax.devices())
+    if len(avail) < need:
+        raise ValueError(
+            f"serving_mesh(tp={tp}, dp={dp}) needs {need} devices, "
+            f"only {len(avail)} available")
+    return make_mesh(MeshSpec(dp=dp, tp=tp, sp=1), devices=avail[:need])
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -48,7 +48,14 @@ The serving subsystem the fractional-chip runtime was built to host:
   the decode pool's pipelined dispatch), and a :class:`DisaggRouter`
   front end preserving bit-exact streams across the handoff, with one
   shared host tier under both pools' prefix tries as the cross-pool
-  cache bus.
+  cache bus;
+- :mod:`sharded` — tensor-parallel serving: a
+  :class:`ShardedServingContext` standing up a ``tp`` serving mesh,
+  Megatron-style param sharding, a head-sharded paged KV pool, and
+  ``shard_map`` twins of every paged dispatch (collectives INSIDE the
+  one compiled program per plan kind, Ulysses re-shard for long
+  prefill chunks) — streams bit-exact with the single-device engine
+  by the no-partial-sums construction.
 """
 
 from .disagg import (DecodePool, DisaggRouter, DisaggTopology, KVMigrator,
@@ -69,6 +76,8 @@ from .paged import (paged_copy_block, paged_decode_span, paged_decode_step,
 from .prefix_index import PrefixIndex
 from .qos import (DEFAULT_TENANT, QOS_GUARANTEE, QOS_OPPORTUNISTIC,
                   FairQueue, TenantRegistry, TenantSpec)
+from .sharded import (ShardDecision, ShardedServingContext, plan_sharding,
+                      serving_sharding_rules)
 
 __all__ = [
     "BlockAllocator",
@@ -96,6 +105,8 @@ __all__ = [
     "Request",
     "RequestResult",
     "ServingEngine",
+    "ShardDecision",
+    "ShardedServingContext",
     "TenantRegistry",
     "TenantSpec",
     "chain_token_runs",
@@ -112,6 +123,8 @@ __all__ = [
     "paged_upload_block",
     "paged_verify_span",
     "plan_prefill_chunks",
+    "plan_sharding",
+    "serving_sharding_rules",
     "unpack_block",
     "unpack_chain",
     "wire_block_bytes",

@@ -105,6 +105,7 @@ import numpy as np
 
 from ..models.decoding import _filter_logits, bucket_width
 from ..models.transformer import TransformerConfig
+from ..parallel.mesh import MeshSpec
 from ..utils.promtext import (MetricFamily, MetricServer, Sample,
                               _format_value)
 from .drafter import NGramDrafter
@@ -117,6 +118,7 @@ from .paged import (paged_copy_block, paged_decode_span,
                     paged_prefill_step, paged_upload_block,
                     paged_verify_span)
 from .prefix_index import PrefixIndex
+from .sharded import ShardedServingContext
 from .qos import (DEFAULT_TENANT, QOS_GUARANTEE, QOS_OPPORTUNISTIC,
                   FairQueue, TenantRegistry, TenantSpec)
 
@@ -297,6 +299,21 @@ class EngineConfig:
     # emitted streams — the router hard-asserts bit-exactness against
     # a monolithic engine.
     pool_role: str = "both"
+    # TENSOR-PARALLEL sharded serving (serving/sharded.py): a MeshSpec
+    # with dp=ep=sp=1 and tp>1 stands up a serving mesh — params shard
+    # Megatron-style, the KV pool head-shards, and every dispatch above
+    # runs as ONE shard_map program with the collectives inside, so the
+    # dispatch counts (and the zero-recompile warmup contract) are
+    # unchanged by the device count.  Streams are BIT-EXACT with the
+    # single-device engine (sharded.py's no-partial-sums construction),
+    # greedy and sampled, so None vs a mesh is the bench's control pair.
+    mesh_spec: Optional[MeshSpec] = None
+    # route prefill chunks at/above this width through the Ulysses
+    # sequence-parallel attention re-shard inside the sharded program
+    # (heads are few and rows are many in a long chunk, so splitting
+    # query time beats splitting heads).  None = always head-parallel.
+    # Requires mesh_spec; bit-exact either way (test-locked).
+    long_context_threshold: Optional[int] = None
 
 
 @dataclass
@@ -524,13 +541,33 @@ class ServingEngine:
                 "shared_host_tier requires prefix_cache=True — the tier "
                 "spills the radix index; there is nothing to spill "
                 "without it")
+        if (ec.long_context_threshold is not None
+                and ec.mesh_spec is None):
+            raise ValueError(
+                "long_context_threshold requires mesh_spec — the "
+                "Ulysses route is a re-shard inside the sharded "
+                "program; a single-device engine has nothing to route")
         # fail fast on a bad filter set, like the dense sampling entries
         _filter_logits(jnp.zeros((1, 2)), ec.top_k, ec.top_p)
+        # tensor-parallel mode: the context owns the mesh, the sharding
+        # decision, parameter placement, and the shard_map twins the
+        # step closures below swap in.  Built BEFORE the pool so the
+        # pool buffers are committed to the KV sharding at allocation
+        # (never materialized replicated first).
+        self._sharded = (ShardedServingContext(
+            config, ec.mesh_spec, params,
+            long_context_threshold=ec.long_context_threshold)
+            if ec.mesh_spec is not None else None)
+        if self._sharded is not None:
+            params = self._sharded.place_params(params)
         self.params = params
         self.model_config = config
         self.engine_config = ec
         self.guard = guard
-        self.pool = init_paged_pool(config, ec.num_blocks, ec.block_size)
+        self.pool = init_paged_pool(
+            config, ec.num_blocks, ec.block_size,
+            kv_sharding=(self._sharded.kv_sharding
+                         if self._sharded is not None else None))
         self.prefix_index = (PrefixIndex(ec.block_size)
                              if ec.prefix_cache else None)
         # the tenant registry must exist before the tier policy (the
@@ -633,6 +670,13 @@ class ServingEngine:
         self.prefix_hit_requests = 0
         self.prefix_hit_tokens = 0  # prompt tokens whose prefill was skipped
         self.cow_copies = 0
+        # sharded serving: ESTIMATED fleet-total bytes moved by the
+        # collectives inside each dispatch kind (shard-shape model in
+        # sharded.dispatch_collective_bytes) — stays all-zero on a
+        # single-device engine, exported as
+        # kubeshare_serving_collective_bytes_total
+        self.collective_bytes: Dict[str, int] = {
+            "prefill_chunk": 0, "decode_span": 0, "verify_span": 0}
         # eviction outcome by reason — the metrics plane's `reason`
         # label (reservation_pressure / quota_drain name the trigger
         # when evicted K/V is destroyed; tier_demote / tier_drop name
@@ -688,8 +732,15 @@ class ServingEngine:
         # dispatch and fuses the first-token pick (only lanes finishing
         # their prompt consume it), so a finished prefill costs no extra
         # dispatch for its first token.
+        sharded = self._sharded
+        sharded_prefill = sharded.prefill if sharded is not None else None
+
         def prefill(w, pk, pv, tables, starts, active, tokens, last_rows,
                     temps, keys):
+            if sharded_prefill is not None:
+                logits, pk, pv = sharded_prefill(
+                    w, pk, pv, tables, starts, active, tokens, last_rows)
+                return pick_rows(logits, temps, keys), pk, pv
             logits, pk, pv = paged_prefill_step(
                 w, cfg, pk, pv, tables, starts, active, tokens, last_rows)
             return pick_rows(logits, temps, keys), pk, pv
@@ -707,11 +758,15 @@ class ServingEngine:
             # ONE dispatch advances every lane up to `span` tokens —
             # the scan body is EXACTLY the single step (paged.py's
             # paged_decode_span, shared verbatim with the mixed step),
-            # so the emitted math is span-invariant.
+            # so the emitted math is span-invariant.  The sharded twin
+            # keeps the same one-dispatch shape: the scan AND the
+            # collectives live inside the program.
             return paged_decode_span(
                 w, cfg, pick_rows, span, eos, pk, pv, tables, lengths,
                 active, tokens, temps, keys, budgets)
 
+        if sharded is not None:
+            decode = sharded.decode_span(pick_rows, span, eos)
         self._decode_step = jax.jit(decode, donate_argnums=(1, 2))
 
         def mixed(w, pk, pv, p_table, p_start, p_tokens, p_last_row,
@@ -728,6 +783,8 @@ class ServingEngine:
                 d_lengths, d_active, d_tokens, d_temps, d_keys,
                 d_budgets)
 
+        if sharded is not None:
+            mixed = sharded.mixed_step(pick_rows, span, eos)
         self._mixed_step = jax.jit(mixed, donate_argnums=(1, 2))
 
         def verify(w, pk, pv, tables, lengths, active, tokens, widths,
@@ -741,6 +798,8 @@ class ServingEngine:
                 w, cfg, pick_rows, pk, pv, tables, lengths, active,
                 tokens, widths, temps, keys)
 
+        if sharded is not None:
+            verify = sharded.verify_span(pick_rows)
         self._verify_step = jax.jit(verify, donate_argnums=(1, 2))
 
         def mixed_verify(w, pk, pv, p_table, p_start, p_tokens,
@@ -755,6 +814,8 @@ class ServingEngine:
                 p_last_row, p_temp, p_key, d_tables, d_lengths,
                 d_active, d_tokens, d_widths, d_temps, d_keys)
 
+        if sharded is not None:
+            mixed_verify = sharded.mixed_verify_step(pick_rows)
         self._mixed_verify_step = jax.jit(mixed_verify,
                                           donate_argnums=(1, 2))
         # the copy-on-write primitive: one block, all layers, K and V —
@@ -765,6 +826,8 @@ class ServingEngine:
         def copy(pk, pv, src, dst):
             return paged_copy_block(pk, pv, src, dst)
 
+        if sharded is not None:
+            copy = sharded.copy_block
         self._copy_step = jax.jit(copy, donate_argnums=(0, 1))
 
         # the KV tier's promotion primitive: one block's host payload
@@ -774,6 +837,11 @@ class ServingEngine:
         def upload(pk, pv, dst, k_slab, v_slab):
             return paged_upload_block(pk, pv, dst, k_slab, v_slab)
 
+        if sharded is not None:
+            # the sharded twin re-scatters the host-shaped slab over the
+            # pool's head sharding, so tier promotion and migration
+            # unpack are sharding-agnostic host-side
+            upload = sharded.upload_block
         self._upload_step = jax.jit(upload, donate_argnums=(0, 1))
 
     # ------------------------------------------------------------------
@@ -1254,8 +1322,12 @@ class ServingEngine:
         tokens.add({}, self.tokens_generated)
         # disaggregated pools tag their latency/dispatch families with
         # a `pool` label; monolithic engines add NO label, so every
-        # existing exact-label-match consumer is untouched
+        # existing exact-label-match consumer is untouched.  The same
+        # discipline for sharding: tensor-parallel engines add a `tp`
+        # (mesh size) constant-label, single-device engines add nothing
         plabel = {"pool": self.pool_label} if self.pool_label else {}
+        if self._sharded is not None:
+            plabel["tp"] = str(self._sharded.tp)
         dispatches = MetricFamily(
             "kubeshare_serving_dispatches_total",
             "Device dispatches by kind (mixed = one fused prefill "
@@ -1393,6 +1465,15 @@ class ServingEngine:
                             self.spec_drafted.get(name, 0))
             spec_tokens.add({"tenant": name, "kind": "accepted"},
                             self.spec_accepted.get(name, 0))
+        coll_bytes = MetricFamily(
+            "kubeshare_serving_collective_bytes_total",
+            "ESTIMATED fleet-total bytes moved by the collectives "
+            "inside sharded dispatches, by kind (shard-shape model, "
+            "not a transport measurement; all-zero on a single-device "
+            "engine).", "counter")
+        for kind in sorted(self.collective_bytes):
+            coll_bytes.add({"kind": kind, **plabel},
+                           self.collective_bytes[kind])
         spec_accept = MetricFamily(
             "kubeshare_serving_spec_acceptance_ratio",
             "Per-verify-round draft acceptance rate (accepted prefix / "
@@ -1406,7 +1487,7 @@ class ServingEngine:
         return [req, blocks, tokens, dispatches, prefix, hit_tokens,
                 evicted, tier_blocks, tier_req, tier_tokens, tier_bytes,
                 tier_stall, ttft, t_depth, t_blocks, t_tokens, preempt,
-                cls_ttft, tbt, spec_tokens, spec_accept]
+                cls_ttft, tbt, coll_bytes, spec_tokens, spec_accept]
 
     def serve_metrics(self, port: int = 0) -> MetricServer:
         """Start the textfile HTTP scrape endpoint (``/metrics`` and
@@ -2051,6 +2132,18 @@ class ServingEngine:
                 keys[i, : len(window)] = window
         return tables, lengths, active, tokens, temps, keys, budgets
 
+    def _charge_collectives(self, family: str, kind: str, *, lanes: int,
+                            chunk: int = 0, span: int = 0,
+                            width: int = 0) -> None:
+        """Account one sharded dispatch's estimated collective traffic
+        (no-op on a single-device engine — the counters stay zero)."""
+        if self._sharded is None:
+            return
+        self.collective_bytes[family] += \
+            self._sharded.dispatch_collective_bytes(
+                kind, lanes=lanes, chunk=chunk, span=span, width=width,
+                view_rows=self._table_width * self.engine_config.block_size)
+
     def _run_prefill_chunk(self, slot: _Slot,
                            chunk: Optional[Tuple[int, int, int]] = None
                            ) -> None:
@@ -2070,6 +2163,8 @@ class ServingEngine:
             temp, key)
         self.pool = replace(self.pool, k=pk, v=pv)
         self.prefill_chunks += 1
+        self._charge_collectives("prefill_chunk", "prefill", lanes=1,
+                                 chunk=segment.shape[1])
         # fair-share service: the prefill width actually dispatched (a
         # prefix-cache hit charges only its uncached suffix — tokend's
         # charge-measured-work principle)
@@ -2089,6 +2184,9 @@ class ServingEngine:
             jnp.asarray(budgets))
         self.pool = replace(self.pool, k=pk, v=pv)
         self.decode_steps += 1
+        self._charge_collectives(
+            "decode_span", "decode", lanes=self.engine_config.num_slots,
+            span=self.engine_config.decode_span)
         self._inflight = ("span", (emitted, list(decode_slots), budgets),
                           None)
 
@@ -2111,6 +2209,11 @@ class ServingEngine:
         self.prefill_chunks += 1
         self.decode_steps += 1
         self.mixed_steps += 1
+        self._charge_collectives("prefill_chunk", "prefill", lanes=1,
+                                 chunk=segment.shape[1])
+        self._charge_collectives(
+            "decode_span", "decode", lanes=self.engine_config.num_slots,
+            span=self.engine_config.decode_span)
         self._queue.charge(p_slot.tenant, chunk[1])
         self._inflight = ("span", (emitted, list(decode_slots), budgets),
                           (p_slot, picked) if final else None)
@@ -2172,6 +2275,9 @@ class ServingEngine:
             jnp.asarray(keys))
         self.pool = replace(self.pool, k=pk, v=pv)
         self.verify_steps += 1
+        self._charge_collectives(
+            "verify_span", "verify", lanes=self.engine_config.num_slots,
+            width=plan.verify_width)
         self._inflight = ("verify",
                           (picked, accepts, list(plan.decode_slots),
                            k_lanes, budgets), None)
@@ -2197,6 +2303,11 @@ class ServingEngine:
         self.prefill_chunks += 1
         self.verify_steps += 1
         self.mixed_verify_steps += 1
+        self._charge_collectives("prefill_chunk", "prefill", lanes=1,
+                                 chunk=segment.shape[1])
+        self._charge_collectives(
+            "verify_span", "verify", lanes=self.engine_config.num_slots,
+            width=plan.verify_width)
         self._queue.charge(p_slot.tenant, chunk[1])
         self._inflight = ("verify",
                           (picked, accepts, list(plan.decode_slots),

@@ -30,6 +30,7 @@ from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -120,10 +121,19 @@ def chain_token_runs(tokens, block_size: int) -> List[List[int]]:
 
 
 def init_paged_pool(
-    config: TransformerConfig, num_blocks: int, block_size: int
+    config: TransformerConfig, num_blocks: int, block_size: int,
+    kv_sharding=None,
 ) -> PagedKVPool:
     """Allocate the static block pool (block 0 is the scratch block, so
-    ``num_blocks - 1`` are allocatable)."""
+    ``num_blocks - 1`` are allocatable).
+
+    ``kv_sharding``: optional ``jax.sharding.Sharding`` the buffers are
+    committed to — the sharded serving context passes a
+    ``NamedSharding`` splitting the KV-head axis over its ``tp`` mesh,
+    so each device materializes only its head shard.  Host reads
+    (:meth:`PagedKVPool.read_block` / :meth:`read_chain`) gather
+    transparently through ``np.asarray``, so tiering and migration are
+    sharding-agnostic."""
     if block_size < 1:
         raise ValueError(f"block_size must be >= 1, got {block_size}")
     if num_blocks < 2:
@@ -133,11 +143,12 @@ def init_paged_pool(
         )
     shape = (config.n_layers, num_blocks, config.kv_heads, block_size,
              config.head_dim)
-    return PagedKVPool(
-        k=jnp.zeros(shape, config.dtype),
-        v=jnp.zeros(shape, config.dtype),
-        block_size=block_size,
-    )
+    k = jnp.zeros(shape, config.dtype)
+    v = jnp.zeros(shape, config.dtype)
+    if kv_sharding is not None:
+        k = jax.device_put(k, kv_sharding)
+        v = jax.device_put(v, kv_sharding)
+    return PagedKVPool(k=k, v=v, block_size=block_size)
 
 
 class BlockAllocator:
