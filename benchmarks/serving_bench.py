@@ -494,6 +494,44 @@ def sharded_settings() -> dict:
     )
 
 
+def loop_smoke_settings() -> dict:
+    """Seconds-fast device-loop path (CI, tests/test_serving.py): a
+    decode-heavy trace — short prompts, ~100-token decodes — so most
+    launches run their full K span-units and the planner-invocation
+    drop is visible through CI noise.  One layer: the smokes lock
+    mechanics (bit-exact streams, zero recompiles, the drop itself),
+    not wall-clock ratios."""
+    return dict(
+        d_model=128, n_layers=1, n_heads=4, n_kv_heads=2, d_ff=256,
+        vocab_size=512, max_seq_len=192,
+        num_requests=12,
+        num_slots=4, block_size=8, num_blocks=97,  # 96 blocks = 768 rows
+        max_request_len=192, prefill_chunk=16,
+        prompt_lo=8, prompt_hi=24, new_lo=96, new_hi=144,
+        steps_per_launch=4,
+        mean_interarrival_s=0.0005, seed=0,
+    )
+
+
+def loop_settings() -> dict:
+    """The device-loop capture configuration (acceptance shape): the
+    full-bench model on a decode-dominated trace (chat-style short
+    prompts, 192-256-token completions) with K=8 — the regime where
+    per-iteration host work (plan + marshal + dispatch) is the bill
+    the device-resident loop exists to cut.  KV budget: 160 blocks x
+    16 = 2560 rows = 8 slots x 320."""
+    return dict(
+        d_model=256, n_layers=4, n_heads=8, n_kv_heads=2, d_ff=1024,
+        vocab_size=4096, max_seq_len=320,
+        num_requests=48,
+        num_slots=8, block_size=16, num_blocks=161,
+        max_request_len=320, prefill_chunk=64,
+        prompt_lo=16, prompt_hi=48, new_lo=192, new_hi=256,
+        steps_per_launch=8,
+        mean_interarrival_s=0.002, seed=0,
+    )
+
+
 def build_tiered_workload(s: dict):
     """Many-distinct-shared-prefixes trace: every request opens with
     one of ``num_prefixes`` common ``prefix_len``-token prefixes
@@ -773,7 +811,8 @@ def run_continuous(params, config, s: dict, trace,
                    tenant_of=None, mixed: bool = True,
                    host_tier_bytes=None, num_blocks=None,
                    speculative: bool = False, tp=None,
-                   long_context_threshold=None) -> dict:
+                   long_context_threshold=None,
+                   steps_per_launch: int = 1) -> dict:
     from kubeshare_tpu.serving import EngineConfig, Request, ServingEngine
 
     mesh_spec = None
@@ -791,7 +830,8 @@ def run_continuous(params, config, s: dict, trace,
         tier_policy=s.get("tier_policy", "lru"),
         speculative=speculative, draft_len=s.get("draft_len", 8),
         mesh_spec=mesh_spec,
-        long_context_threshold=long_context_threshold),
+        long_context_threshold=long_context_threshold,
+        steps_per_launch=steps_per_launch),
         tenants=registry)
     engine.warmup()
     compiles_before = engine.compile_counts()
@@ -858,6 +898,22 @@ def run_continuous(params, config, s: dict, trace,
         "mixed_verify_steps": int(_metric_value(
             metric, "kubeshare_serving_dispatches_total",
             kind="mixed_verify")),
+        # device-resident loop stats via the scrape surface: launches,
+        # span-units they covered, and the host-overhead numerators the
+        # loop exists to cut (planner invocations + per-phase seconds)
+        "loop_launches": int(_metric_value(
+            metric, "kubeshare_serving_dispatches_total", kind="loop")),
+        "loop_units": int(_metric_value(
+            metric, "kubeshare_serving_loop_units_total")),
+        "planner_invocations": int(_metric_value(
+            metric, "kubeshare_serving_host_planner_invocations_total")),
+        "planner_per_token": _metric_value(
+            metric, "kubeshare_serving_host_planner_invocations_total")
+        / max(1, useful),
+        "host_seconds": {
+            dict(labels)["phase"]: float(v)
+            for (name, labels), v in metric.items()
+            if name == "kubeshare_serving_host_seconds_total"},
         # target-model dispatches per emitted token (decode spans +
         # verify chunks; prefill is phase-independent) — speculation's
         # headline denominator
@@ -1312,6 +1368,90 @@ def run_mixed_bench(s: dict, aba: bool = True) -> dict:
         "tokens_per_s_ratio": on["tokens_per_s"] / max(1e-9, off_tps),
         "tbt_p50_ratio": off_p50 / max(1e-9, on["tbt_s"]["p50"]),
         "tbt_p99_ratio": off_p99 / max(1e-9, on["tbt_s"]["p99"]),
+        "streams_bit_exact": True,
+        "recompiles_after_warmup": recompiles,
+        "platform": jax.default_backend(),
+    }
+
+
+def run_loop_bench(s: dict, aba: bool = True) -> dict:
+    """Device-resident multi-step loop ON (``steps_per_launch=K``) vs
+    OFF (K=1) on one decode-heavy trace: same engine geometry, same
+    pool, same KV-HBM budget — the comparison isolates what batching K
+    scheduler iterations into one compiled launch buys.  The
+    acceptance bar (full settings): host planner invocations per
+    emitted token drop ~K x on the decode phase, every stream
+    bit-exact between the two arms, zero recompiles after warmup.
+    ``aba=False`` drops the second bracketing K=1 run (tests lock
+    mechanics, not timing)."""
+    config, params = _bench_model(s)
+    trace = build_workload(s)
+    k = s["steps_per_launch"]
+
+    # ABA bracket: the first trace run in a process pays one-time host
+    # costs that would otherwise be misattributed to whichever arm
+    # runs first, and host_seconds is a WALL metric — so the loop run
+    # is bracketed by two K=1 runs and compared against their mean
+    off_a = run_continuous(params, config, s, trace)
+    on = run_continuous(params, config, s, trace, steps_per_launch=k)
+    off_b = (run_continuous(params, config, s, trace) if aba else off_a)
+    recompiles = (on.pop("recompiles") + off_a.pop("recompiles")
+                  + (off_b.pop("recompiles") if aba else 0))
+    if recompiles:
+        raise RuntimeError(
+            f"{recompiles} recompilations after warmup — a static-shape "
+            f"leak; the comparison (and a TPU serving pod) is invalid")
+    # the tentpole's correctness half, end to end: batching K
+    # iterations into one launch may not change a single token
+    mismatched = [
+        rid for rid in on["requests"]
+        if on["requests"][rid]["tokens"] != off_a["requests"][rid]["tokens"]
+        or on["requests"][rid]["tokens"] != off_b["requests"][rid]["tokens"]]
+    if mismatched:
+        raise RuntimeError(
+            f"streams diverged between K={k} and K=1 for {mismatched} "
+            f"— the device-resident loop is NOT bit-exact")
+    if on["loop_launches"] == 0:
+        raise RuntimeError(
+            "the device loop never fired — the trace is not "
+            "decode-heavy enough to measure anything")
+    on.pop("requests")
+    off_a.pop("requests")
+    if aba:
+        off_b.pop("requests")
+    off_planner = (off_a["planner_invocations"]
+                   + off_b["planner_invocations"]) / 2
+    off_host = (sum(off_a["host_seconds"].values())
+                + sum(off_b["host_seconds"].values())) / 2
+    on_host = sum(on["host_seconds"].values())
+    off_tps = (off_a["tokens_per_s"] + off_b["tokens_per_s"]) / 2
+    return {
+        "suite": "serving-loop",
+        "metric": "host planner invocations per emitted token at "
+                  "steps_per_launch=K over K=1 (same decode-heavy "
+                  "Poisson trace, same engine geometry and KV-HBM "
+                  "budget; planner and host-seconds read through the "
+                  "metrics plane; K=1 = mean of the two bracketing "
+                  "runs)",
+        "settings": {key: v for key, v in s.items()},
+        "steps_per_launch": k,
+        "loop": on,
+        "unlooped_first": off_a,
+        "unlooped_last": off_b,
+        "unlooped": {"tokens_per_s": off_tps,
+                     "planner_invocations": off_planner,
+                     "planner_per_token": (off_a["planner_per_token"]
+                                           + off_b["planner_per_token"])
+                     / 2,
+                     "host_seconds_total": off_host},
+        "planner_invocations_ratio":
+            off_planner / max(1, on["planner_invocations"]),
+        "host_seconds_ratio": off_host / max(1e-9, on_host),
+        "tokens_per_s_ratio": on["tokens_per_s"] / max(1e-9, off_tps),
+        # units per launch actually realized (early exits pull it
+        # under K; a decode-heavy trace should sit near K)
+        "realized_fusion_depth":
+            on["loop_units"] / max(1, on["loop_launches"]),
         "streams_bit_exact": True,
         "recompiles_after_warmup": recompiles,
         "platform": jax.default_backend(),
@@ -1814,6 +1954,12 @@ def main() -> None:
                              "single-device at equal per-device KV "
                              "budget (streams hard-asserted identical; "
                              "dispatch/collective-bytes headline)")
+    parser.add_argument("--device-loop", action="store_true",
+                        help="device-resident multi-step loop "
+                             "(steps_per_launch=K) vs K=1 on a "
+                             "decode-heavy trace (streams hard-asserted "
+                             "identical; planner-invocations-per-token "
+                             "headline)")
     parser.add_argument("--json", help="write the result JSON here too")
     args = parser.parse_args()
     if args.sharded and "host_platform_device_count" not in \
@@ -1843,6 +1989,9 @@ def main() -> None:
     elif args.tiered:
         result = run_tiered_bench(
             tiered_smoke_settings() if args.smoke else tiered_settings())
+    elif args.device_loop:
+        result = run_loop_bench(
+            loop_smoke_settings() if args.smoke else loop_settings())
     elif args.mixed:
         result = run_mixed_bench(
             mixed_smoke_settings() if args.smoke else mixed_settings())
@@ -1925,6 +2074,20 @@ def main() -> None:
               f"promotions, {tier['dropped']} drops, "
               f"{1e3 * tier['promotion_stall_s']:.1f} ms promotion "
               f"stall; streams bit-exact", file=sys.stderr)
+        return
+    if args.device_loop:
+        on, off = result["loop"], result["unlooped"]
+        k = result["steps_per_launch"]
+        print(f"\ndevice loop (K={k}): planner invocations/token "
+              f"{on['planner_per_token']:.3f} vs "
+              f"{off['planner_per_token']:.3f} at K=1 "
+              f"({result['planner_invocations_ratio']:.2f}x fewer, "
+              f"target ~{k}x on the decode phase); host seconds "
+              f"{result['host_seconds_ratio']:.2f}x lower; realized "
+              f"fusion depth {result['realized_fusion_depth']:.1f}/{k}; "
+              f"tokens/s ratio {result['tokens_per_s_ratio']:.3f}; "
+              f"{on['loop_launches']} launches; streams bit-exact",
+              file=sys.stderr)
         return
     if args.mixed:
         on, off = result["mixed"], result["unmixed"]

@@ -93,7 +93,13 @@ def main() -> None:
         # drafter proposes up to draft_len tokens, one width-W verify
         # dispatch scores every lane, and exact-match acceptance keeps
         # all streams bit-identical to speculation off
-        speculative=True, draft_len=4)
+        speculative=True, draft_len=4,
+        # device-resident multi-step loop: on pure-decode steps, ONE
+        # compiled launch runs up to 4 scheduler iterations of the
+        # decode span on device (sampling, stop detection and the
+        # emitted-token ring included) — the host planner fires per
+        # launch, not per span, and streams stay bit-exact with K=1
+        steps_per_launch=4)
     dense_bytes = (2 * config.n_layers * engine_config.num_slots
                    * config.kv_heads * config.max_seq_len
                    * config.head_dim * 4)

@@ -69,8 +69,8 @@ from .kv_tier import (KV_CHAIN_VERSION, KV_WIRE_VERSION, HostTier,
                       LRUTierPolicy, QoSTierPolicy, TierPolicy, pack_block,
                       pack_chain, unpack_block, unpack_chain,
                       wire_block_bytes)
-from .paged import (paged_copy_block, paged_decode_span, paged_decode_step,
-                    paged_gather_kv, paged_mixed_step,
+from .paged import (paged_copy_block, paged_decode_loop, paged_decode_span,
+                    paged_decode_step, paged_gather_kv, paged_mixed_step,
                     paged_mixed_verify_step, paged_prefill_step,
                     paged_upload_block, paged_verify_span)
 from .prefix_index import PrefixIndex
@@ -114,6 +114,7 @@ __all__ = [
     "pack_block",
     "pack_chain",
     "paged_copy_block",
+    "paged_decode_loop",
     "paged_decode_span",
     "paged_decode_step",
     "paged_gather_kv",

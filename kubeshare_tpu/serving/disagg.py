@@ -431,6 +431,18 @@ class DisaggRouter:
                     f"prefill/decode pools disagree on {name}: "
                     f"{pv!r} vs {dv!r} — shared geometry is what makes "
                     f"a migrated slot a drop-in continuation")
+        if decode_priority is not None \
+                and decode_config.steps_per_launch > 1:
+            raise ValueError(
+                f"decode_priority pacing is incompatible with the "
+                f"decode pool's device-resident loop (steps_per_launch="
+                f"{decode_config.steps_per_launch}): the pacing counts "
+                f"HOST decode steps to interleave prefill, but a loop "
+                f"launch runs up to K scheduler iterations headless — "
+                f"the router would pace against launches, not steps, "
+                f"silently starving prefill by up to K x; set "
+                f"steps_per_launch=1 on the decode pool or drop "
+                f"decode_priority")
         self.tenants = tenants or TenantRegistry.default()
         p_share = prefill_config.num_blocks - 1
         d_share = decode_config.num_blocks - 1

@@ -113,10 +113,10 @@ from .kv_blocks import (BlockAllocator, BlockExhausted, QuotaExceeded,
                         init_paged_pool)
 from .kv_tier import (HostTier, LRUTierPolicy, QoSTierPolicy, pack_block,
                       unpack_block, wire_block_bytes)
-from .paged import (paged_copy_block, paged_decode_span,
-                    paged_mixed_step, paged_mixed_verify_step,
-                    paged_prefill_step, paged_upload_block,
-                    paged_verify_span)
+from .paged import (paged_copy_block, paged_decode_loop,
+                    paged_decode_span, paged_mixed_step,
+                    paged_mixed_verify_step, paged_prefill_step,
+                    paged_upload_block, paged_verify_span)
 from .prefix_index import PrefixIndex
 from .sharded import ShardedServingContext
 from .qos import (DEFAULT_TENANT, QOS_GUARANTEE, QOS_OPPORTUNISTIC,
@@ -229,6 +229,17 @@ class EngineConfig:
     # step-identical, lanes self-deactivate mid-span on budget/EOS, so
     # equivalence survives any span.  1 = dispatch per token.
     decode_span: int = 4
+    # DEVICE-RESIDENT MULTI-STEP LOOP: fuse up to K consecutive decode
+    # scheduler iterations into ONE compiled launch (a lax.while_loop
+    # of span-units, each the exact decode-span scan).  Emissions ring-
+    # buffer on device; the loop exits early at a span boundary the
+    # moment any lane deactivates (budget/EOS), so the host only runs
+    # the planner at admission/retire/preemption boundaries — planner
+    # invocations per emitted token drop ~K x on decode-heavy phases.
+    # Streams are bit-exact with K=1 by construction (the loop is
+    # consecutive identical decode plans batched into one launch).
+    # Must be a power of two >= 1; 1 = one plan per launch (off).
+    steps_per_launch: int = 1
     eos_token: Optional[int] = None
     # sampling restriction set, engine-wide (temperature rides per
     # request; the filter set is part of the compiled step)
@@ -501,6 +512,19 @@ class ServingEngine:
             raise ValueError(f"prefill_chunk must be >= 1, got {ec.prefill_chunk}")
         if ec.decode_span < 1:
             raise ValueError(f"decode_span must be >= 1, got {ec.decode_span}")
+        if ec.steps_per_launch < 1 or (
+                ec.steps_per_launch & (ec.steps_per_launch - 1)):
+            raise ValueError(
+                f"steps_per_launch must be a power of two >= 1, got "
+                f"{ec.steps_per_launch} — the loop warms exactly one "
+                f"shape per config, and power-of-two K keeps the knob "
+                f"space aligned with the other fused widths")
+        if ec.steps_per_launch > 1 and ec.pool_role == "prefill":
+            raise ValueError(
+                f"steps_per_launch {ec.steps_per_launch} is meaningless "
+                f"on a prefill-role pool — it never runs decode plans, "
+                f"so the device loop would silently never fire; set "
+                f"steps_per_launch=1")
         if ec.mixed_prefill_budget is not None and ec.mixed_prefill_budget < 1:
             raise ValueError(
                 f"mixed_prefill_budget must be >= 1 or None, got "
@@ -655,6 +679,22 @@ class ServingEngine:
         self.mixed_steps = 0
         self.verify_steps = 0
         self.mixed_verify_steps = 0
+        # device-resident loop counters: launches (fused dispatches)
+        # and the span-units those launches actually ran.  Each unit is
+        # one decode_span's worth of work and is absorbed into
+        # decode_steps, so the standalone decode_span dispatch count
+        # becomes decode_steps - mixed_steps - loop_units (a launch is
+        # ONE dispatch covering loop_units/loop_launches units on
+        # average — exactly the amortization the loop exists to buy)
+        self.loop_launches = 0
+        self.loop_units = 0
+        # host-overhead observability (the device loop's proof plane):
+        # wall seconds per scheduling phase of step(), and the number
+        # of planner invocations — the numerator and denominator the
+        # --device-loop bench divides by emitted tokens
+        self.host_seconds: Dict[str, float] = {
+            "admit": 0.0, "plan": 0.0, "dispatch": 0.0, "consume": 0.0}
+        self.host_planner_invocations = 0
         # speculation counters, per tenant: proposals scored by verify
         # dispatches, drafts actually emitted, and the per-round
         # acceptance-ratio histogram ([bucket counts, ratio sum] —
@@ -768,6 +808,24 @@ class ServingEngine:
         if sharded is not None:
             decode = sharded.decode_span(pick_rows, span, eos)
         self._decode_step = jax.jit(decode, donate_argnums=(1, 2))
+
+        k_units = ec.steps_per_launch
+
+        def loop(w, pk, pv, tables, lengths, active, tokens, temps,
+                 keys, budgets):
+            # the device-resident multi-step loop: up to K span-units
+            # (each the exact decode scan above) in ONE launch, with
+            # on-device ring buffering and a lanes-changed early exit
+            # — the host planner runs once per launch instead of once
+            # per span.  Built only when steps_per_launch > 1.
+            return paged_decode_loop(
+                w, cfg, pick_rows, span, k_units, eos, pk, pv, tables,
+                lengths, active, tokens, temps, keys, budgets)
+
+        if sharded is not None and k_units > 1:
+            loop = sharded.decode_loop(pick_rows, span, k_units, eos)
+        self._loop_step = (jax.jit(loop, donate_argnums=(1, 2))
+                           if k_units > 1 else None)
 
         def mixed(w, pk, pv, p_table, p_start, p_tokens, p_last_row,
                   p_temp, p_key, d_tables, d_lengths, d_active,
@@ -1027,13 +1085,27 @@ class ServingEngine:
         on an unguarded engine it overlaps device execution; the
         emitted tokens are then consumed (planning needs fresh lane
         state — the drafter reads ``generated``) and the next step
-        dispatched.  Returns False when the engine is fully idle."""
+        dispatched.  Returns False when the engine is fully idle.
+
+        Every phase is wall-timed into ``host_seconds`` (exported as
+        ``kubeshare_serving_host_seconds_total{phase}``) — the raw
+        material for proving, not asserting, that the device-resident
+        loop removes host overhead from the decode hot path."""
+        hs = self.host_seconds
+        t0 = time.monotonic()
         self._admit()
+        t1 = time.monotonic()
         consumed = self._consume_inflight()
+        t2 = time.monotonic()
         plan = self._plan_step()
+        t3 = time.monotonic()
+        hs["admit"] += t1 - t0
+        hs["consume"] += t2 - t1
+        hs["plan"] += t3 - t2
         if plan is None:
             return consumed
         self._dispatch_plan(plan)
+        hs["dispatch"] += time.monotonic() - t3
         return True
 
     def _plan_step(self) -> Optional[_StepPlan]:
@@ -1052,7 +1124,10 @@ class ServingEngine:
         rotate round-robin so a many-chunk prompt cannot monopolize
         prefill ticks.  The decode phase itself has two variants
         (:meth:`_plan_decode_phase`): the plain span, or — speculative
-        mode, when any lane drafted — one verify chunk."""
+        mode, when any lane drafted — one verify chunk, or — with
+        ``steps_per_launch > 1`` and a pure-decode step — the
+        device-resident multi-step loop."""
+        self.host_planner_invocations += 1
         prefill = [s for s in self._slots if s.state == "prefill"]
         decode = [s for s in self._slots if s.state == "decode"]
         ec = self.engine_config
@@ -1065,7 +1140,7 @@ class ServingEngine:
                 # still stalls decode, for a single bounded dispatch
                 return _StepPlan("prefill", prefill_slot=slot,
                                  chunk=chunk)
-            plan = self._plan_decode_phase(decode)
+            plan = self._plan_decode_phase(decode, fused=True)
             plan.kind = ("mixed_verify" if plan.kind == "verify"
                          else "mixed")
             plan.prefill_slot, plan.chunk = slot, chunk
@@ -1078,14 +1153,29 @@ class ServingEngine:
             return self._plan_decode_phase(decode)
         return None
 
-    def _plan_decode_phase(self, decode: List[_Slot]) -> _StepPlan:
+    def _plan_decode_phase(self, decode: List[_Slot],
+                           fused: bool = False) -> _StepPlan:
         """Decode-phase variant selection.  Speculative mode: lanes
         whose drafter found a continuation ride ONE verify chunk;
         lanes without a draft ride along at width 1 (for them the
         chunk IS a decode step — one pick, one emission).  When nobody
         drafted, the plain decode span is strictly better (it emits up
         to ``decode_span`` per dispatch), so the plan falls back to
-        it."""
+        it.
+
+        The device loop (``steps_per_launch > 1``) fires only on the
+        pure-decode fallback of a NON-fused step: a mixed step carries
+        per-chunk prefill host work and a verify round needs per-round
+        host drafting, so neither can run headless for K units.  Under
+        speculation the loop therefore batches only no-draft rounds —
+        it may skip the re-draft checks a K=1 engine would have made
+        between those rounds, which changes SCHEDULING (fewer verify
+        opportunities) but never streams (verification is exact-match
+        against the engine's own picks, so every schedule emits the
+        identical tokens).  The launch ENVELOPE is this plan: which
+        lanes, span width, and up to K units; the dispatcher runs the
+        fused program and the device decides how many units actually
+        execute."""
         ec = self.engine_config
         if ec.speculative:
             drafts = self._plan_drafts(decode)
@@ -1094,6 +1184,8 @@ class ServingEngine:
                     max(len(d) for d in drafts.values()))
                 return _StepPlan("verify", decode_slots=decode,
                                  drafts=drafts, verify_width=width)
+        if self._loop_step is not None and not fused:
+            return _StepPlan("loop", decode_slots=decode)
         return _StepPlan("decode", decode_slots=decode)
 
     def _plan_drafts(self, decode: List[_Slot]) -> Dict[int, List[int]]:
@@ -1126,6 +1218,8 @@ class ServingEngine:
             self._run_prefill_chunk(plan.prefill_slot, plan.chunk)
         elif plan.kind == "verify":
             self._run_verify_step(plan)
+        elif plan.kind == "loop":
+            self._run_loop_step(plan.decode_slots)
         else:
             self._run_decode_step(plan.decode_slots)
 
@@ -1247,6 +1341,21 @@ class ServingEngine:
                 jnp.zeros((s,), jnp.float32),
                 jnp.zeros((s, ec.decode_span, 2), jnp.uint32), zeros_s)
             self.pool = replace(self.pool, k=pk, v=pv)
+        if self._loop_step is not None:
+            # the device loop's ONE shape (K is baked in; lane masks,
+            # budgets, and the units-ran count are all dynamic).  The
+            # all-inactive warmup call exits at unit 0 — the loop cond
+            # checks any(alive) precisely so warmup costs one compile
+            # and zero scratch-block work.
+            _, _, pk, pv = self._loop_step(
+                self.params, self.pool.k, self.pool.v,
+                jnp.zeros((s, self._table_width), jnp.int32),
+                zeros_s, jnp.zeros((s,), bool), zeros_s,
+                jnp.zeros((s,), jnp.float32),
+                jnp.zeros((s, ec.steps_per_launch * ec.decode_span, 2),
+                          jnp.uint32),
+                zeros_s)
+            self.pool = replace(self.pool, k=pk, v=pv)
         if ec.speculative and ec.pool_role != "prefill":
             # verify widths are 1 + pow2(max draft) with the adaptive
             # controller confined to power-of-two widths <= draft_len,
@@ -1293,6 +1402,8 @@ class ServingEngine:
             "mixed_verify": self._mixed_verify_step._cache_size(),
             "copy": self._copy_step._cache_size(),
             "upload": self._upload_step._cache_size(),
+            "loop": (self._loop_step._cache_size()
+                     if self._loop_step is not None else 0),
         }
 
     # ------------------------------------------------------------------
@@ -1332,19 +1443,46 @@ class ServingEngine:
             "kubeshare_serving_dispatches_total",
             "Device dispatches by kind (mixed = one fused prefill "
             "chunk + decode span, mixed_verify = prefill chunk + "
-            "verify chunk; the standalone kinds exclude fused work).",
-            "counter")
+            "verify chunk, loop = one device-resident multi-step "
+            "launch covering loop_units span-units; the standalone "
+            "kinds exclude fused work).", "counter")
         dispatches.add({"kind": "prefill_chunk", **plabel},
                        self.prefill_chunks - self.mixed_steps
                        - self.mixed_verify_steps)
         dispatches.add({"kind": "decode_span", **plabel},
-                       self.decode_steps - self.mixed_steps)
+                       self.decode_steps - self.mixed_steps
+                       - self.loop_units)
         dispatches.add({"kind": "mixed", **plabel}, self.mixed_steps)
         dispatches.add({"kind": "verify_span", **plabel},
                        self.verify_steps - self.mixed_verify_steps)
         dispatches.add({"kind": "mixed_verify", **plabel},
                        self.mixed_verify_steps)
+        dispatches.add({"kind": "loop", **plabel}, self.loop_launches)
         dispatches.add({"kind": "cow_copy", **plabel}, self.cow_copies)
+        loop_units = MetricFamily(
+            "kubeshare_serving_loop_units_total",
+            "Decode span-units executed inside device-resident loop "
+            "launches (units / the loop dispatch count = the realized "
+            "fusion depth; at most steps_per_launch per launch).",
+            "counter")
+        loop_units.add(dict(plabel), self.loop_units)
+        host_s = MetricFamily(
+            "kubeshare_serving_host_seconds_total",
+            "Host wall seconds inside the engine's step loop, by "
+            "scheduling phase (admit / consume / plan / dispatch — "
+            "dispatch is marshal + launch enqueue on an unguarded "
+            "engine).  The numerator of the host-overhead-per-token "
+            "ratio the device-resident loop exists to cut.", "counter")
+        for phase in sorted(self.host_seconds):
+            host_s.add({"phase": phase, **plabel},
+                       self.host_seconds[phase])
+        planner = MetricFamily(
+            "kubeshare_serving_host_planner_invocations_total",
+            "Scheduler planner invocations (_plan_step calls).  With "
+            "steps_per_launch=K, invocations per emitted token drop "
+            "~K x on decode-heavy phases — the device loop's headline "
+            "claim, measured rather than asserted.", "counter")
+        planner.add(dict(plabel), self.host_planner_invocations)
         prefix = MetricFamily(
             "kubeshare_serving_prefix_cache_requests_total",
             "Admitted requests by prefix-cache outcome.", "counter")
@@ -1484,10 +1622,11 @@ class ServingEngine:
             _histogram_samples(
                 spec_accept, "kubeshare_serving_spec_acceptance_ratio",
                 {"tenant": name}, counts, total, SPEC_ACCEPT_BUCKETS)
-        return [req, blocks, tokens, dispatches, prefix, hit_tokens,
-                evicted, tier_blocks, tier_req, tier_tokens, tier_bytes,
-                tier_stall, ttft, t_depth, t_blocks, t_tokens, preempt,
-                cls_ttft, tbt, coll_bytes, spec_tokens, spec_accept]
+        return [req, blocks, tokens, dispatches, loop_units, host_s,
+                planner, prefix, hit_tokens, evicted, tier_blocks,
+                tier_req, tier_tokens, tier_bytes, tier_stall, ttft,
+                t_depth, t_blocks, t_tokens, preempt, cls_ttft, tbt,
+                coll_bytes, spec_tokens, spec_accept]
 
     def serve_metrics(self, port: int = 0) -> MetricServer:
         """Start the textfile HTTP scrape endpoint (``/metrics`` and
@@ -2104,17 +2243,22 @@ class ServingEngine:
                 jnp.asarray((slot.first_key if final else
                              np.zeros(2, np.uint32))[None]))
 
-    def _decode_lanes(self, decode_slots: List[_Slot]):
+    def _decode_lanes(self, decode_slots: List[_Slot],
+                      n_steps: Optional[int] = None):
         """Device arguments for a decode span over the slot pool —
-        shared by the standalone and the mixed dispatch."""
+        shared by the standalone, the mixed, and (with ``n_steps`` =
+        K*span) the device-loop dispatch.  The key window is sliced
+        flat: a K-unit loop consumes exactly the keys K back-to-back
+        span dispatches would, at the same emission indices."""
         ec = self.engine_config
-        s, span = ec.num_slots, ec.decode_span
+        s = ec.num_slots
+        steps = ec.decode_span if n_steps is None else n_steps
         tables = np.zeros((s, self._table_width), np.int32)
         lengths = np.zeros((s,), np.int32)
         active = np.zeros((s,), bool)
         tokens = np.zeros((s,), np.int32)
         temps = np.zeros((s,), np.float32)
-        keys = np.zeros((s, span, 2), np.uint32)
+        keys = np.zeros((s, steps, 2), np.uint32)
         budgets = np.zeros((s,), np.int32)
         for slot in decode_slots:
             i = slot.idx
@@ -2128,7 +2272,7 @@ class ServingEngine:
                 # this span consumes the request's next step keys in the
                 # exact dense-split order
                 offset = len(slot.generated) - 1
-                window = slot.step_keys[offset: offset + span]
+                window = slot.step_keys[offset: offset + steps]
                 keys[i, : len(window)] = window
         return tables, lengths, active, tokens, temps, keys, budgets
 
@@ -2189,6 +2333,28 @@ class ServingEngine:
             span=self.engine_config.decode_span)
         self._inflight = ("span", (emitted, list(decode_slots), budgets),
                           None)
+
+    def _run_loop_step(self, decode_slots: List[_Slot]) -> None:
+        """Launch the device-resident multi-step loop: up to
+        ``steps_per_launch`` span-units in ONE dispatch.  The ring and
+        the units-ran scalar stay on device until consumed — reading
+        ``units`` here would force a sync and break the one-step-ahead
+        pipeline, so ALL unit-proportional bookkeeping (decode_steps,
+        loop_units, collective byte charges) is deferred to
+        :meth:`_consume_inflight`."""
+        ec = self.engine_config
+        n_steps = ec.steps_per_launch * ec.decode_span
+        tables, lengths, active, tokens, temps, keys, budgets = \
+            self._decode_lanes(decode_slots, n_steps)
+        ring, units, pk, pv = self._dispatch(
+            self._loop_step, self.params, self.pool.k, self.pool.v,
+            jnp.asarray(tables), jnp.asarray(lengths), jnp.asarray(active),
+            jnp.asarray(tokens), jnp.asarray(temps), jnp.asarray(keys),
+            jnp.asarray(budgets))
+        self.pool = replace(self.pool, k=pk, v=pv)
+        self.loop_launches += 1
+        self._inflight = ("loop", (ring, units, list(decode_slots),
+                                   budgets), None)
 
     def _run_mixed_step(self, decode_slots: List[_Slot], p_slot: _Slot,
                         chunk: Tuple[int, int, int]) -> None:
@@ -2333,6 +2499,24 @@ class ServingEngine:
                 picked, accepts, slots, k_lanes, budgets = decode_part
                 self._accept_verify(slots, np.asarray(picked),
                                     np.asarray(accepts), k_lanes, budgets)
+            elif kind == "loop":
+                # the device loop's epilogue drain: only NOW (the one
+                # device sync) is it known how many span-units actually
+                # ran, so the unit-proportional counters land here —
+                # each unit is one decode_span of work, charged exactly
+                # as K=1 span dispatches would have charged it
+                ring, units_dev, slots, budgets = decode_part
+                units = int(np.asarray(units_dev))
+                span = self.engine_config.decode_span
+                self.decode_steps += units
+                self.loop_units += units
+                self._charge_collectives(
+                    "decode_span", "decode",
+                    lanes=self.engine_config.num_slots,
+                    span=units * span)
+                emitted = np.asarray(ring)[: units * span]
+                self._accept_decode(slots, emitted, budgets,
+                                    n_steps=units * span)
             else:
                 emitted, slots, budgets = decode_part
                 self._accept_decode(slots, np.asarray(emitted), budgets)
@@ -2398,9 +2582,16 @@ class ServingEngine:
         slot.state = "free"
 
     def _accept_decode(self, decode_slots: List[_Slot],
-                       emitted: np.ndarray, budgets: np.ndarray) -> None:
+                       emitted: np.ndarray, budgets: np.ndarray,
+                       n_steps: Optional[int] = None) -> None:
+        """Host acceptance for a decode span — or, with ``n_steps`` =
+        units*span, for a device-loop ring drain.  The ring case is the
+        span case verbatim: because the loop exits at the first span
+        boundary where any lane deactivated, every accepted row was
+        produced by an alive lane, and the budget cap / EOS truncation
+        walk below reads exactly the rows K=1 consumes would have."""
         ec = self.engine_config
-        span = ec.decode_span
+        span = ec.decode_span if n_steps is None else n_steps
         now = time.monotonic()
         for slot in decode_slots:
             i = slot.idx

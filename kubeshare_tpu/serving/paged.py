@@ -19,6 +19,11 @@ only the cache plumbing:
   ``lax.scan`` of step-identical :func:`paged_decode_step` iterations
   with the engine's token-pick policy between steps (lanes
   self-deactivate on budget/EOS);
+- :func:`paged_decode_loop`: the device-resident multi-step loop — up
+  to K consecutive span-units (each one the EXACT span scan above)
+  inside a ``lax.while_loop``, emissions ring-buffered on device and
+  an early exit at span boundaries the moment any lane deactivates
+  (the host's cue that the lane set changed and scheduling must run);
 - :func:`paged_mixed_step`: the stall-free mixed dispatch — ONE program
   that consumes one bounded prefill chunk for one filling slot AND runs
   a full decode span for every active lane.  It is a pure composition
@@ -331,6 +336,122 @@ def paged_decode_span(
     (pk, pv, _, _, _), emitted = jax.lax.scan(
         body, carry, jnp.arange(span))
     return emitted, pk, pv
+
+
+def _decode_loop_impl(
+    step_fn,
+    pick_fn,
+    span: int,
+    k_units: int,
+    eos,
+    pool_k,
+    pool_v,
+    tables,
+    lengths,
+    active,
+    tokens,
+    temps,
+    keys,
+    budgets,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """The device-resident multi-step loop's shared body, parameterized
+    by the single-token decode step (``paged_decode_step`` here, the
+    shard_map-local twin in serving/sharded.py) so both engines run the
+    IDENTICAL loop construction.
+
+    Each while-loop iteration is one SPAN-UNIT: the exact scan body of
+    :func:`paged_decode_span`, with the emission index flattened across
+    units (unit u, step j consumes ``keys[:, u*span + j]`` and checks
+    ``u*span + j + 1 < budgets`` — arithmetically identical to the
+    re-marshaled per-dispatch budget a K=1 engine would compute, since a
+    still-alive lane accepted exactly ``span`` tokens per earlier unit).
+    The unit's emissions land in the on-device ring at rows
+    ``[u*span, (u+1)*span)``.
+
+    Early exit — the "lanes changed" device flag: the loop continues
+    only while every initially-active lane is still alive.  The moment
+    any lane deactivates (budget spent or EOS), the host's next plan
+    would differ (retire, admit, preempt), so the loop stops at that
+    span boundary and hands control back.  Whenever no lane changed,
+    the K=1 host would have re-issued the IDENTICAL decode plan — the
+    loop is literally consecutive identical decode plans batched into
+    one launch, which is the whole bit-exactness argument.
+
+    Returns (ring [k_units*span, S], units ran [], pool_k, pool_v);
+    ring rows at and past ``units*span`` are zeros the host never
+    reads.  An all-inactive call (warmup) runs zero units.
+    """
+    s = tables.shape[0]
+
+    def unit_body(carry, j):
+        u, pk, pv, lens, toks, alive = carry
+        logits, pk, pv = step_fn(pk, pv, tables, lens, alive, toks)
+        i = u * span + j
+        nxt = pick_fn(logits, temps, jnp.take(keys, i, axis=1))
+        lens = lens + alive.astype(jnp.int32)
+        cont = alive & (i + 1 < budgets)
+        if eos is not None:
+            cont = cont & (nxt != eos)
+        return (u, pk, pv, lens, nxt, cont), nxt
+
+    def cond(carry):
+        u, ring, pk, pv, lens, toks, alive = carry
+        # continue while units remain AND the lane set is unchanged —
+        # jnp.any(alive) also exits an all-inactive (warmup) call at
+        # unit 0 instead of spinning K units of scratch-block work
+        return ((u < k_units) & jnp.any(alive)
+                & ~jnp.any(active & ~alive))
+
+    def body(carry):
+        u, ring, pk, pv, lens, toks, alive = carry
+        (_, pk, pv, lens, toks, alive), emitted = jax.lax.scan(
+            unit_body, (u, pk, pv, lens, toks, alive), jnp.arange(span))
+        ring = jax.lax.dynamic_update_slice(ring, emitted, (u * span, 0))
+        return (u + 1, ring, pk, pv, lens, toks, alive)
+
+    ring = jnp.zeros((k_units * span, s), jnp.int32)
+    units, ring, pk, pv, _, _, _ = jax.lax.while_loop(
+        cond, body,
+        (jnp.asarray(0, jnp.int32), ring, pool_k, pool_v, lengths,
+         tokens, active))
+    return ring, units, pk, pv
+
+
+def paged_decode_loop(
+    params,
+    config: TransformerConfig,
+    pick_fn,
+    span: int,
+    k_units: int,
+    eos,
+    pool_k,
+    pool_v,
+    tables,
+    lengths,
+    active,
+    tokens,
+    temps,
+    keys,
+    budgets,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Up to ``k_units`` consecutive decode span-units in ONE dispatch —
+    the device-resident step loop (``EngineConfig.steps_per_launch``).
+
+    ``keys`` [S, k_units*span, 2] is the flat key window (the engine
+    slices each lane's step-key schedule exactly as ``k_units``
+    back-to-back span dispatches would); ``budgets`` [S] the remaining
+    emission budgets at launch.  Returns (ring [k_units*span, S],
+    units [], pool_k, pool_v) — see :func:`_decode_loop_impl` for the
+    boundary semantics and the bit-exactness-with-K=1 argument.
+    """
+
+    def step_fn(pk, pv, tbl, lens, alive, toks):
+        return paged_decode_step(
+            params, config, pk, pv, tbl, lens, alive, toks)
+
+    return _decode_loop_impl(
+        step_fn, pick_fn, span, k_units, eos, pool_k, pool_v, tables,
+        lengths, active, tokens, temps, keys, budgets)
 
 
 def paged_verify_span(

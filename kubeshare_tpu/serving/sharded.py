@@ -81,7 +81,8 @@ from ..models.decoding import _attend_cached, speculative_acceptance
 from ..models.transformer import TransformerConfig, _rms_norm
 from ..ops.rope import apply_rope
 from ..parallel.mesh import MeshSpec, make_mesh, param_spec_tree, shard_params
-from .paged import _moe_or_mlp, paged_copy_block, paged_upload_block
+from .paged import (_decode_loop_impl, _moe_or_mlp, paged_copy_block,
+                    paged_upload_block)
 
 # the paged pool is [n_layers, num_blocks, kv_heads, block_size, head_dim];
 # head-sharding splits axis 2, so every block's rows for a device's KV
@@ -468,6 +469,32 @@ class ShardedServingContext:
         return self._smap(
             local, (self._pspecs, kv, kv, r, r, r, r, r, r, r),
             (r, kv, kv))
+
+    def decode_loop(self, pick_fn, span: int, k_units: int, eos):
+        """The device-resident multi-step loop's sharded twin: the
+        while-loop AND the collectives live inside ONE shard_map
+        program (``paged._decode_loop_impl`` over the local decode
+        step).  The loop condition reads only replicated values (the
+        gathered logits make every device's picks — and therefore its
+        alive masks — identical), so all devices take the same number
+        of units and the ring/units outputs are replicated by
+        construction."""
+        cfg, dec = self.config, self.decision
+        kv, r = self.kv_spec, P()
+
+        def local(w, pk, pv, tables, lengths, active, tokens, temps,
+                  keys, budgets):
+            def step_fn(spk, spv, tbl, lens, alive, toks):
+                return _local_decode_step(
+                    w, cfg, dec, spk, spv, tbl, lens, alive, toks)
+
+            return _decode_loop_impl(
+                step_fn, pick_fn, span, k_units, eos, pk, pv, tables,
+                lengths, active, tokens, temps, keys, budgets)
+
+        return self._smap(
+            local, (self._pspecs, kv, kv, r, r, r, r, r, r, r),
+            (r, r, kv, kv))
 
     def verify_span(self, pick_fn):
         cfg, dec = self.config, self.decision

@@ -2626,6 +2626,230 @@ class TestDisagg:
                                   20))
 
 
+class TestDeviceLoop:
+    """Tentpole contract: ``steps_per_launch=K`` compiles ONE device-
+    resident loop running up to K scheduler iterations of the paged
+    decode span — sampling, stop/budget detection and the emitted-token
+    ring all on device, early exit the moment any lane deactivates —
+    and emits EXACTLY the K=1 streams, greedy and sampled, across
+    GQA/windowed/MoE, preemption-resume and retire, with zero new
+    compiled shapes after warmup."""
+
+    def _pair(self, params, config, k, **overrides):
+        from kubeshare_tpu.serving import EngineConfig, ServingEngine
+
+        kwargs = dict(num_slots=3, block_size=4, num_blocks=41,
+                      max_request_len=48, prefill_chunk=8,
+                      steps_per_launch=k)
+        kwargs.update(overrides)
+        return ServingEngine(params, config, EngineConfig(**kwargs))
+
+    def _streams(self, engine, reqs):
+        from kubeshare_tpu.serving import Request
+
+        for req in reqs:
+            engine.submit(Request(**req))
+        return {rid: r.tokens for rid, r in engine.run().items()}
+
+    def test_streams_bit_exact_loop_on_vs_off_across_configs(self):
+        """Loop on vs off, token for token, same workload: lanes at
+        staggered budgets so launches exit early at different units,
+        admissions landing between launches.  The GQA case carries
+        SAMPLED lanes (the flat key index u*span+j must hand emission k
+        exactly the key the K=1 re-marshaled dispatches would)."""
+        cases = {
+            "gqa_rope": dict(n_kv_heads=2, positional="rope"),
+            "windowed": dict(attention_window=6),
+            "moe": dict(moe_every=2, moe_num_experts=4, moe_top_k=2),
+        }
+        rng = np.random.default_rng(71)
+        reqs = [
+            dict(rid="long", prompt=rng.integers(0, 64, 29),
+                 max_new_tokens=14),
+            dict(rid="s0", prompt=rng.integers(0, 64, 5),
+                 max_new_tokens=9),
+            dict(rid="s1", prompt=rng.integers(0, 64, 13),
+                 max_new_tokens=4),
+            dict(rid="long2", prompt=rng.integers(0, 64, 21),
+                 max_new_tokens=11),
+        ]
+        sampled = [
+            dict(rid="samp", prompt=rng.integers(0, 64, 13),
+                 max_new_tokens=12, temperature=0.8,
+                 rng=jax.random.PRNGKey(72)),
+            dict(rid="samp2", prompt=rng.integers(0, 64, 11),
+                 max_new_tokens=7, temperature=1.1,
+                 rng=jax.random.PRNGKey(73)),
+        ]
+        for name, extra in cases.items():
+            config = _small_config(**extra)
+            params = transformer_init(jax.random.PRNGKey(0), config)
+            workload = reqs + (sampled if name == "gqa_rope" else [])
+            kwargs = (dict(top_k=10, top_p=0.95)
+                      if name == "gqa_rope" else {})
+            on = self._pair(params, config, 4, **kwargs)
+            off = self._pair(params, config, 1, **kwargs)
+            got = self._streams(on, workload)
+            want = self._streams(off, workload)
+            assert got == want, name
+            # the loop actually ran (and the control arm has none)
+            assert on.loop_launches > 0, name
+            assert on.loop_units > 0, name
+            assert off.loop_launches == 0, name
+
+    def test_planner_invocations_drop_on_decode_heavy_trace(self):
+        """The point of the PR: on a decode-dominated trace the host
+        planner runs ~K x fewer times per emitted token (each launch
+        covers up to K iterations the K=1 engine plans one by one)."""
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        rng = np.random.default_rng(74)
+        reqs = [dict(rid="d", prompt=rng.integers(0, 64, 5),
+                     max_new_tokens=32)]
+        counts = {}
+        for k in (1, 4):
+            engine = self._pair(params, config, k)
+            streams = self._streams(engine, list(reqs))
+            assert len(streams["d"]) == 32
+            counts[k] = engine.host_planner_invocations
+            # the counter flows through the metrics plane
+            sample = [sm for f in engine.collect_metrics()
+                      if f.name ==
+                      "kubeshare_serving_host_planner_invocations_total"
+                      for sm in f.samples]
+            assert sample and sample[0].value == counts[k]
+        # 32 tokens / span 4 = 8 decode plans at K=1 vs 2 launches at
+        # K=4; prefill + drain plans are common to both arms
+        assert counts[4] < counts[1]
+        assert counts[1] - counts[4] >= 4
+
+    def test_mid_scan_preemption_resume_bit_exact(self):
+        """A Guarantee admission preempting an Opportunistic lane MID
+        FLIGHT under the loop: the in-flight ring is consumed first
+        (its accepted tokens are real), the victim retires into the
+        prefix cache and resumes emitting EXACTLY its unpreempted
+        stream — against the dense greedy oracle."""
+        from kubeshare_tpu.models.decoding import greedy_decode
+        from kubeshare_tpu.serving import (QOS_OPPORTUNISTIC,
+                                           EngineConfig, Request,
+                                           ServingEngine,
+                                           TenantRegistry, TenantSpec)
+
+        config = _small_config(n_kv_heads=2, positional="rope")
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        registry = TenantRegistry([
+            TenantSpec("gold"),
+            TenantSpec("batch", qos_class=QOS_OPPORTUNISTIC),
+        ])
+        engine = ServingEngine(
+            params, config,
+            EngineConfig(num_slots=2, block_size=4, num_blocks=13,
+                         max_request_len=32, prefill_chunk=8,
+                         steps_per_launch=4),
+            tenants=registry)
+        engine.warmup()
+        baseline = engine.compile_counts()
+        rng = np.random.default_rng(75)
+        # same block geometry as TestQoSPreemption (victim grows to 8
+        # blocks, gold needs 6 > 4 free -> preempt) but the victim's
+        # 22-token budget OUTLASTS one 16-deep launch (K*span), so gold
+        # arrives while a launch is in flight: the preemption consumes
+        # that ring first — its accepted tokens are real — then evicts
+        p_batch = rng.integers(0, 64, 9)   # 9 + 22 = 31 rows, 8 blocks
+        p_gold = rng.integers(0, 64, 18)   # 18 + 6 = 24 rows, 6 blocks
+        engine.submit(Request("victim", p_batch, 22, tenant="batch"))
+        while True:
+            r = engine.result("victim")
+            if r.first_token_at is not None and not r.done:
+                break
+            assert engine.step(), "engine idle before victim decoded"
+        engine.submit(Request("gold", p_gold, 6, tenant="gold"))
+        out = engine.run()
+        assert engine.preemptions.get("batch", 0) >= 1
+        assert engine.loop_launches >= 1
+        for rid, prompt, new in (("victim", p_batch, 22),
+                                 ("gold", p_gold, 6)):
+            ref = np.asarray(greedy_decode(
+                params, config, jnp.asarray(prompt, jnp.int32)[None],
+                new))[0]
+            assert out[rid].tokens == list(ref), rid
+        assert engine.allocator.blocks_in_use == 0
+        assert engine.compile_counts() == baseline
+
+    def test_ring_drained_at_retire(self):
+        """A budget ending mid-launch: the device detects it (budget
+        check per emission, early exit at the unit boundary), the host
+        drains the ring capped at the lane's budget — never a token
+        past max_new_tokens, never a dropped one — and the launch
+        stops short of its K units."""
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        rng = np.random.default_rng(76)
+        # 10 tokens, span 4, K=4: the sole lane dies at emission 10 of
+        # a 16-deep ring -> exit after unit 3 of 4
+        engine = self._pair(params, config, 4)
+        streams = self._streams(
+            engine, [dict(rid="short", prompt=rng.integers(0, 64, 5),
+                          max_new_tokens=10)])
+        assert len(streams["short"]) == 10
+        assert engine.loop_launches >= 1
+        # early exit: units actually run < launches * K
+        assert engine.loop_units < engine.loop_launches * 4
+        assert engine.allocator.blocks_in_use == 0
+
+    def test_zero_recompiles_after_warmup(self):
+        """The loop program is warmed once (all-inactive lanes, exits
+        at unit 0) and never compiles again — across greedy, sampled,
+        early exits and admissions between launches."""
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        engine = self._pair(params, config, 4, top_k=10, top_p=0.95)
+        engine.warmup()
+        baseline = engine.compile_counts()
+        assert baseline["loop"] >= 1
+        rng = np.random.default_rng(77)
+        self._streams(engine, [
+            dict(rid="a", prompt=rng.integers(0, 64, 9),
+                 max_new_tokens=13),
+            dict(rid="b", prompt=rng.integers(0, 64, 17),
+                 max_new_tokens=6, temperature=0.9,
+                 rng=jax.random.PRNGKey(78)),
+            dict(rid="c", prompt=rng.integers(0, 64, 5),
+                 max_new_tokens=10),
+        ])
+        assert engine.loop_launches >= 1
+        assert engine.compile_counts() == baseline
+
+    def test_config_validation_is_loud(self):
+        """Satellite: bad K values and incompatible combos fail at
+        construction, not deep in a launch."""
+        from kubeshare_tpu.serving import (DisaggRouter, EngineConfig,
+                                           ServingEngine)
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        for bad in (0, -1, 3, 6):
+            with pytest.raises(ValueError, match="power of two"):
+                ServingEngine(params, config, EngineConfig(
+                    num_slots=2, block_size=4, num_blocks=13,
+                    max_request_len=32, prefill_chunk=8,
+                    steps_per_launch=bad))
+        with pytest.raises(ValueError, match="never runs decode"):
+            ServingEngine(params, config, EngineConfig(
+                num_slots=2, block_size=4, num_blocks=13,
+                max_request_len=32, prefill_chunk=8, mixed=False,
+                pool_role="prefill", steps_per_launch=2))
+        shared = dict(block_size=4, max_request_len=32,
+                      prefill_chunk=8, mixed=False)
+        with pytest.raises(ValueError, match="decode_priority pacing"):
+            DisaggRouter(
+                params, config,
+                EngineConfig(num_slots=2, num_blocks=17, **shared),
+                EngineConfig(num_slots=2, num_blocks=17,
+                             steps_per_launch=2, **shared),
+                decode_priority=2)
+
+
 class TestServingBenchSmoke:
     def test_smoke_ratio_and_zero_recompiles(self):
         """The bench's CPU smoke path: continuous vs run-to-completion

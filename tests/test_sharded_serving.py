@@ -274,6 +274,48 @@ class TestShardedServing:
         engine.run()
         assert engine.compile_counts() == baseline
 
+    def test_device_loop_sharded_bit_exact(self):
+        """The device-resident multi-step loop under tp: the while-loop
+        and its collectives live inside ONE shard_map program (the cond
+        reads only replicated values, so every device runs the same
+        unit count) and the sharded K=4 engine emits EXACTLY the
+        single-device K=1 streams — greedy and sampled — with the same
+        ~K x planner-invocation drop and zero recompiles after warmup,
+        ``compile_counts()[\"loop\"]`` included."""
+        from kubeshare_tpu.serving import EngineConfig, Request, ServingEngine
+
+        config = _small_config()  # 4 KV heads: head-sharded on tp=4
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        base = dict(num_slots=3, block_size=4, num_blocks=41,
+                    max_request_len=48, prefill_chunk=8,
+                    top_k=10, top_p=0.95)
+        rng = np.random.default_rng(9)
+        reqs = [
+            dict(rid="d", prompt=rng.integers(0, 64, 5),
+                 max_new_tokens=24),
+            dict(rid="s", prompt=rng.integers(0, 64, 13),
+                 max_new_tokens=9, temperature=0.8,
+                 rng=jax.random.PRNGKey(10)),
+        ]
+        single = ServingEngine(params, config, EngineConfig(**base))
+        for req in reqs:
+            single.submit(Request(**req))
+        want = {rid: r.tokens for rid, r in single.run().items()}
+
+        engine = _sharded_engine(params, config, steps_per_launch=4,
+                                 top_k=10, top_p=0.95)
+        engine.warmup()
+        baseline = engine.compile_counts()
+        assert baseline["loop"] >= 1
+        for req in reqs:
+            engine.submit(Request(**req))
+        got = {rid: r.tokens for rid, r in engine.run().items()}
+        assert got == want
+        assert engine.loop_launches >= 1
+        assert engine.host_planner_invocations < \
+            single.host_planner_invocations
+        assert engine.compile_counts() == baseline
+
     def test_cow_divergence_sharded(self):
         """Sharded CoW: a mid-block divergence copies the shared tail
         block through the shard_map copy twin, and neither the
