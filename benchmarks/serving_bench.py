@@ -88,8 +88,11 @@ Run:
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --disagg --smoke
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --sharded
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --sharded --smoke
+    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --fleet
+    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --fleet --smoke
     make serve-smoke serve-prefix-smoke serve-qos-smoke serve-mixed-smoke \
-         serve-tier-smoke serve-disagg-smoke serve-sharded-smoke
+         serve-tier-smoke serve-disagg-smoke serve-sharded-smoke \
+         serve-fleet-smoke
 
 - ``--disagg`` switches to the DISAGGREGATED PREFILL/DECODE
   comparison: the long-prefill/steady-decode adversarial trace
@@ -125,6 +128,20 @@ Run:
   shrink, so the tokens/s ratio is PROVENANCE, not a headline —
   dispatch counts, collective-bytes estimates, and the tp-x KV
   capacity are the portable numbers (docs/perf.md).
+
+- ``--fleet`` switches to the REPLICA-FLEET ROUTING comparison: a
+  shared-prefix-heavy open-loop trace (several distinct prefix
+  families) replayed through a 2-replica :class:`ReplicaFleet` with
+  prefix-affinity routing vs the round-robin control — same fleet,
+  same AGGREGATE KV-HBM budget (per-replica allocatable blocks sum to
+  the monolithic pool's, asserted), affinity ABA-bracketed by two
+  round-robin runs.  A monolithic single-engine run at the full
+  budget anchors correctness: every stream is hard-asserted identical
+  across all arms (routing changes where prompts prefill, never what
+  they emit).  Headline: aggregate prefix-skip rate affinity vs
+  round-robin, with the routing-decision mix read back through the
+  fleet's merged metrics plane and zero recompiles asserted
+  fleet-wide.
 """
 
 from __future__ import annotations
@@ -532,6 +549,51 @@ def loop_settings() -> dict:
     )
 
 
+def fleet_smoke_settings() -> dict:
+    """Seconds-fast replica-fleet path (CI, make serve-fleet-smoke):
+    a 2-replica fleet whose pools sum to the monolithic 48-block
+    budget (24 allocatable each), on a 4-family shared-prefix trace.
+    The 44-token prefix is deliberately NOT a block multiple so the
+    mid-block tail path runs here too; arrivals are paced so a
+    family's first request retires before its siblings arrive — the
+    regime where the router's choice decides the hit rate."""
+    return dict(
+        d_model=128, n_layers=1, n_heads=4, n_kv_heads=2, d_ff=256,
+        vocab_size=512, max_seq_len=96,
+        num_requests=24,
+        num_slots=6, block_size=8, num_blocks=49,
+        replicas=2, replica_num_slots=3,
+        max_request_len=96, prefill_chunk=16,
+        prompt_lo=8, prompt_hi=64, new_lo=4, new_hi=16,
+        shared_fraction=0.8, num_groups=4, prefix_len=44,
+        tail_lo=4, tail_hi=16,
+        mean_interarrival_s=0.01, seed=0,
+    )
+
+
+def fleet_settings() -> dict:
+    """The replica-fleet capture configuration: the full-bench model,
+    2 replicas splitting the monolithic 160-block budget (80
+    allocatable each), 6 prefix families of 256 tokens — a working set
+    no single replica could have kept warm under round-robin.
+    Arrivals at 200 ms mean: routing happens at SUBMIT time, so unlike
+    the single-engine shared-prefix suite (where queued requests still
+    hit at admission) the trace must be paced against service time for
+    the router's probe to see a warm trie at all."""
+    return dict(
+        d_model=256, n_layers=4, n_heads=8, n_kv_heads=2, d_ff=1024,
+        vocab_size=4096, max_seq_len=320,
+        num_requests=48,
+        num_slots=12, block_size=16, num_blocks=161,
+        replicas=2, replica_num_slots=6,
+        max_request_len=320, prefill_chunk=64,
+        prompt_lo=8, prompt_hi=192, new_lo=4, new_hi=32,
+        shared_fraction=0.8, num_groups=6, prefix_len=256,
+        tail_lo=8, tail_hi=16,
+        mean_interarrival_s=0.2, seed=0,
+    )
+
+
 def build_tiered_workload(s: dict):
     """Many-distinct-shared-prefixes trace: every request opens with
     one of ``num_prefixes`` common ``prefix_len``-token prefixes
@@ -737,6 +799,42 @@ def build_shared_workload(s: dict):
             ).astype(np.int32)
         trace.append((rid, prompt, max_new, t))
     return trace, sharers
+
+
+def build_fleet_workload(s: dict):
+    """Shared-prefix-HEAVY trace for the replica-fleet comparison:
+    ``shared_fraction`` of requests belong to one of ``num_groups``
+    prefix families (each family shares its own ``prefix_len``-token
+    opener — distinct system prompts / few-shot templates), the rest
+    are mixed-length background.  Arrivals are open-loop Poisson on
+    the shared clock.  Returns (trace, group_of) with group_of[rid]
+    naming the family (None for background) — the bench aggregates
+    skip rates per family and overall."""
+    rng = np.random.default_rng(s["seed"])
+    prefixes = [
+        rng.integers(0, s["vocab_size"], s["prefix_len"]).astype(np.int32)
+        for _ in range(s["num_groups"])]
+    trace, group_of = [], {}
+    t = 0.0
+    for i in range(s["num_requests"]):
+        t += float(rng.exponential(s["mean_interarrival_s"]))
+        rid = f"req{i}"
+        max_new = int(rng.integers(s["new_lo"], s["new_hi"] + 1))
+        if rng.random() < s["shared_fraction"]:
+            g = int(rng.integers(0, s["num_groups"]))
+            tail = rng.integers(
+                0, s["vocab_size"],
+                int(rng.integers(s["tail_lo"], s["tail_hi"] + 1)))
+            prompt = np.concatenate([prefixes[g], tail]).astype(np.int32)
+            group_of[rid] = g
+        else:
+            prompt = rng.integers(
+                0, s["vocab_size"],
+                int(rng.integers(s["prompt_lo"], s["prompt_hi"] + 1))
+            ).astype(np.int32)
+            group_of[rid] = None
+        trace.append((rid, prompt, max_new, t))
+    return trace, group_of
 
 
 def _bench_model(s: dict):
@@ -1144,6 +1242,178 @@ def run_disagg(params, config, s: dict, trace, registry=None,
         "preemptions": preemptions,
         "recompiles": recompiles,
         "requests": requests,
+    }
+
+
+def run_fleet(params, config, s: dict, trace, routing=None) -> dict:
+    """Replica-fleet arm: one :class:`ReplicaFleet` of ``replicas``
+    engines, each funded with 1/N of the monolithic arm's allocatable
+    KV blocks, replayed with the same open-loop drive as
+    ``run_continuous``.  ``routing=None`` takes the fleet's default
+    :class:`PrefixAffinityPolicy`; the round-robin control passes
+    ``RoundRobinPolicy()``.  Skipped-prefix and routing stats are read
+    back through the merged metrics plane (the collector scrape
+    surface), not bench-side arithmetic."""
+    from kubeshare_tpu.serving import EngineConfig, ReplicaFleet, Request
+
+    replicas = s["replicas"]
+    replica_blocks = (s["num_blocks"] - 1) // replicas + 1
+    fleet = ReplicaFleet(
+        params, config,
+        EngineConfig(
+            num_slots=s["replica_num_slots"], block_size=s["block_size"],
+            num_blocks=replica_blocks,
+            max_request_len=s["max_request_len"],
+            prefill_chunk=s["prefill_chunk"],
+            decode_span=s.get("decode_span", 4)),
+        replicas=replicas, routing=routing)
+    fleet.warmup()
+    compiles_before = fleet.compile_counts()
+
+    start = time.monotonic()
+    pending = list(trace)
+    while pending or not fleet.idle:
+        now = time.monotonic() - start
+        while pending and pending[0][3] <= now:
+            rid, prompt, max_new, _ = pending.pop(0)
+            fleet.submit(Request(rid, prompt, max_new))
+        if not fleet.step() and pending:
+            time.sleep(min(0.001, pending[0][3] - now))
+    elapsed = time.monotonic() - start
+
+    recompiles = sum(fleet.compile_counts().values()) - sum(
+        compiles_before.values())
+    useful = sum(min(len(fleet.result(rid).tokens), max_new)
+                 for rid, _, max_new, _ in trace)
+    prompt_tokens = sum(len(prompt) for _, prompt, _, _ in trace)
+    ttfts = []
+    requests = {}
+    for rid, _, max_new, arrival in trace:
+        r = fleet.result(rid)
+        ttfts.append((r.first_token_at - start) - arrival)
+        requests[rid] = {
+            "arrival_s": arrival,
+            "ttft_s": (r.first_token_at - start) - arrival,
+            "owner": fleet.owner_of(rid),
+            "tokens": list(r.tokens),
+        }
+    metric = {(sm.name, tuple(sorted(sm.labels.items()))): sm.value
+              for f in fleet.collect_metrics() for sm in f.samples}
+    hit_tokens = int(_metric_value(
+        metric, "kubeshare_serving_prefix_hit_tokens_total"))
+    per_replica_dispatches = {}
+    for (name, labels), v in metric.items():
+        if name != "kubeshare_serving_dispatches_total":
+            continue
+        rep = dict(labels).get("replica")
+        if rep:
+            per_replica_dispatches[rep] = (
+                per_replica_dispatches.get(rep, 0) + int(v))
+    return {
+        "replicas": replicas,
+        "kv_blocks_per_replica": replica_blocks - 1,
+        "tokens_per_s": useful / elapsed,
+        "useful_tokens": useful,
+        "elapsed_s": elapsed,
+        "ttft_s": _percentiles(ttfts),
+        # the headline numerator: prompt tokens NOT prefilled because a
+        # replica's radix trie already held them
+        "prefix_hit_tokens": hit_tokens,
+        "prefix_skip_rate": hit_tokens / max(1, prompt_tokens),
+        "prefix_hit_requests": int(_metric_value(
+            metric, "kubeshare_serving_prefix_cache_requests_total",
+            result="hit")),
+        "routing_decisions": {
+            dict(labels)["reason"]: int(v)
+            for (name, labels), v in metric.items()
+            if name == "kubeshare_serving_fleet_routing_decisions_total"},
+        "per_replica_dispatches": per_replica_dispatches,
+        "recompiles": recompiles,
+        "requests": requests,
+    }
+
+
+def run_fleet_bench(s: dict, aba: bool = True) -> dict:
+    """Prefix-affinity routing vs round-robin over a 2-replica fleet at
+    equal AGGREGATE KV budget (replicas x per-replica allocatable ==
+    monolithic allocatable — asserted), on one shared-prefix-heavy
+    open-loop trace.  The affinity run is ABA-bracketed by two
+    round-robin runs (first-trace host costs bias whichever arm runs
+    first); a monolithic single-engine run at the full budget anchors
+    bit-exactness — every stream is hard-asserted identical across ALL
+    arms, so routing provably never changes tokens, only where prompts
+    prefill.  Headline: aggregate prefix-skip rate affinity vs
+    round-robin (the router's whole contribution), with the routing
+    decision mix alongside and zero recompiles asserted fleet-wide.
+    ``aba=False`` drops the bracketing second round-robin run."""
+    from kubeshare_tpu.serving import RoundRobinPolicy
+
+    config, params = _bench_model(s)
+    replicas = s["replicas"]
+    mono_blocks = s["num_blocks"] - 1
+    if mono_blocks % replicas:
+        raise ValueError(
+            f"monolithic budget of {mono_blocks} allocatable blocks "
+            f"does not split across {replicas} replicas — the "
+            f"equal-aggregate-HBM comparison needs an even carve")
+    trace, group_of = build_fleet_workload(s)
+    shared_requests = sum(1 for g in group_of.values() if g is not None)
+
+    mono = run_continuous(params, config, s, trace, mixed=True)
+    off_a = run_fleet(params, config, s, trace,
+                      routing=RoundRobinPolicy())
+    on = run_fleet(params, config, s, trace)  # default = affinity
+    off_b = (run_fleet(params, config, s, trace,
+                       routing=RoundRobinPolicy()) if aba else off_a)
+    per_replica = on["kv_blocks_per_replica"]
+    if per_replica * replicas != mono_blocks:
+        raise ValueError(
+            f"fleet budget {replicas}x{per_replica} allocatable blocks "
+            f"!= monolithic {mono_blocks} — the equal-aggregate-HBM "
+            f"claim is broken")
+    recompiles = (on["recompiles"] + off_a["recompiles"]
+                  + (off_b["recompiles"] if aba else 0)
+                  + mono["recompiles"])
+    if recompiles:
+        raise RuntimeError(
+            f"{recompiles} recompilations after warmup — a static-shape "
+            f"leak; the comparison (and a TPU serving pod) is invalid")
+    mismatched = [
+        rid for rid, _, _, _ in trace
+        if not (mono["requests"][rid]["tokens"]
+                == on["requests"][rid]["tokens"]
+                == off_a["requests"][rid]["tokens"]
+                == off_b["requests"][rid]["tokens"])]
+    if mismatched:
+        raise RuntimeError(
+            f"streams diverged across fleet/monolithic arms for "
+            f"{mismatched} — replica routing is NOT bit-exact")
+    for arm in (mono, on, off_a) + ((off_b,) if aba else ()):
+        arm.pop("requests")
+    mono.pop("recompiles", None)
+    off_skip = (off_a["prefix_skip_rate"] + off_b["prefix_skip_rate"]) / 2
+    off_tps = (off_a["tokens_per_s"] + off_b["tokens_per_s"]) / 2
+    return {
+        "suite": "serving-fleet",
+        "metric": "aggregate prefix-skip rate, affinity routing vs "
+                  "round-robin over the same fleet (same shared-prefix "
+                  "Poisson trace, same aggregate KV-HBM budget; skips "
+                  "read through the merged metrics plane; round-robin "
+                  "= mean of the two bracketing runs)",
+        "settings": {k: v for k, v in s.items()},
+        "shared_requests": shared_requests,
+        "affinity": on,
+        "round_robin_first": off_a,
+        "round_robin_last": off_b,
+        "round_robin": {"prefix_skip_rate": off_skip,
+                        "tokens_per_s": off_tps},
+        "monolithic": mono,
+        "prefix_skip_rate_ratio":
+            on["prefix_skip_rate"] / max(1e-9, off_skip),
+        "tokens_per_s_ratio": on["tokens_per_s"] / max(1e-9, off_tps),
+        "streams_bit_exact": True,
+        "recompiles_after_warmup": recompiles,
+        "platform": jax.default_backend(),
     }
 
 
@@ -1960,6 +2230,12 @@ def main() -> None:
                              "decode-heavy trace (streams hard-asserted "
                              "identical; planner-invocations-per-token "
                              "headline)")
+    parser.add_argument("--fleet", action="store_true",
+                        help="replica fleet: prefix-affinity routing vs "
+                             "round-robin at equal aggregate KV budget "
+                             "(streams hard-asserted identical vs the "
+                             "monolithic engine; aggregate prefix-skip "
+                             "rate headline)")
     parser.add_argument("--json", help="write the result JSON here too")
     args = parser.parse_args()
     if args.sharded and "host_platform_device_count" not in \
@@ -1977,7 +2253,10 @@ def main() -> None:
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
             + " --xla_force_host_platform_device_count=2")
-    if args.sharded:
+    if args.fleet:
+        result = run_fleet_bench(
+            fleet_smoke_settings() if args.smoke else fleet_settings())
+    elif args.sharded:
         result = run_sharded_bench(
             sharded_smoke_settings() if args.smoke else sharded_settings())
     elif args.disagg:
@@ -2009,6 +2288,22 @@ def main() -> None:
     if args.json:
         with open(args.json, "w") as f:
             f.write(text + "\n")
+    if args.fleet:
+        on, rr = result["affinity"], result["round_robin"]
+        mix = on["routing_decisions"]
+        print(f"\nreplica fleet ({on['replicas']} replicas x "
+              f"{on['kv_blocks_per_replica']} KV blocks == monolithic "
+              f"budget): aggregate prefix-skip rate "
+              f"{100 * on['prefix_skip_rate']:.1f}% affinity vs "
+              f"{100 * rr['prefix_skip_rate']:.1f}% round-robin "
+              f"({result['prefix_skip_rate_ratio']:.2f}x, target > 1x); "
+              f"routing mix affinity={mix.get('affinity', 0)} "
+              f"least_loaded={mix.get('least_loaded', 0)} "
+              f"spill={mix.get('spill', 0)}; tokens/s ratio "
+              f"{result['tokens_per_s_ratio']:.3f}; streams bit-exact "
+              f"across all arms incl. monolithic; zero recompiles "
+              f"after warmup", file=sys.stderr)
+        return
     if args.sharded:
         on = result["sharded"]
         coll = result["collective_bytes"]

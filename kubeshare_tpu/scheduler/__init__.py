@@ -3,6 +3,7 @@ from .podgroup import PodGroupInfo, PodGroupRegistry, parse_pod_group_labels
 from .plugin import KubeShareScheduler, SchedulerArgs
 from .framework import SchedulerEngine, CycleStatus
 from .leader import LeaderElector
+from .placement import FleetPlacementPlane, ReplicaPlacement
 
 __all__ = [
     "PodStatus",
@@ -16,4 +17,6 @@ __all__ = [
     "SchedulerEngine",
     "CycleStatus",
     "LeaderElector",
+    "FleetPlacementPlane",
+    "ReplicaPlacement",
 ]

@@ -55,7 +55,17 @@ The serving subsystem the fractional-chip runtime was built to host:
   ``shard_map`` twins of every paged dispatch (collectives INSIDE the
   one compiled program per plan kind, Ulysses re-shard for long
   prefill chunks) — streams bit-exact with the single-device engine
-  by the no-partial-sums construction.
+  by the no-partial-sums construction;
+- :mod:`fleet` — replica fleet serving over the ``dp`` axis: a
+  :class:`ReplicaFleet` front end standing up N engines (single-device,
+  tp-sharded over carved device groups, or factory-built disagg pairs),
+  routing each arrival by longest cached prefix
+  (:class:`PrefixAffinityPolicy`, QoS-aware spill, pluggable), growing
+  and shrinking online from the TTFT histogram families
+  (:class:`TTFTBreachPolicy` with hysteresis), and draining retirees
+  through the shared host tier so survivors inherit their caches —
+  streams bit-exact with one monolithic engine at equal aggregate KV
+  budget.
 """
 
 from .disagg import (DecodePool, DisaggRouter, DisaggTopology, KVMigrator,
@@ -63,6 +73,9 @@ from .disagg import (DecodePool, DisaggRouter, DisaggTopology, KVMigrator,
 from .drafter import NGramDrafter
 from .engine import (EngineConfig, Request, RequestResult, ServingEngine,
                      plan_prefill_chunks)
+from .fleet import (PrefixAffinityPolicy, ReplicaFleet, ReplicaHandle,
+                    RoundRobinPolicy, RoutingPolicy, ScalingPolicy,
+                    TTFTBreachPolicy)
 from .kv_blocks import (BlockAllocator, BlockExhausted, PagedKVPool,
                         QuotaExceeded, chain_token_runs, init_paged_pool)
 from .kv_tier import (KV_CHAIN_VERSION, KV_WIRE_VERSION, HostTier,
@@ -76,7 +89,8 @@ from .paged import (paged_copy_block, paged_decode_loop, paged_decode_span,
 from .prefix_index import PrefixIndex
 from .qos import (DEFAULT_TENANT, QOS_GUARANTEE, QOS_OPPORTUNISTIC,
                   FairQueue, TenantRegistry, TenantSpec)
-from .sharded import (ShardDecision, ShardedServingContext, plan_sharding,
+from .sharded import (ShardDecision, ShardedServingContext,
+                      carve_replica_groups, plan_sharding,
                       serving_sharding_rules)
 
 __all__ = [
@@ -96,19 +110,27 @@ __all__ = [
     "NGramDrafter",
     "PagedKVPool",
     "PrefillPool",
+    "PrefixAffinityPolicy",
     "PrefixIndex",
     "QoSTierPolicy",
     "TierPolicy",
     "QOS_GUARANTEE",
     "QOS_OPPORTUNISTIC",
     "QuotaExceeded",
+    "ReplicaFleet",
+    "ReplicaHandle",
     "Request",
     "RequestResult",
+    "RoundRobinPolicy",
+    "RoutingPolicy",
+    "ScalingPolicy",
     "ServingEngine",
     "ShardDecision",
     "ShardedServingContext",
+    "TTFTBreachPolicy",
     "TenantRegistry",
     "TenantSpec",
+    "carve_replica_groups",
     "chain_token_runs",
     "init_paged_pool",
     "pack_block",

@@ -422,6 +422,7 @@ class DisaggRouter:
         ledger_hook=None,
         max_pending_handoffs: Optional[int] = None,
         decode_priority: Optional[int] = None,
+        replica_label: Optional[str] = None,
     ) -> None:
         for name in _SHARED_GEOMETRY:
             pv, dv = (getattr(prefill_config, name),
@@ -466,7 +467,8 @@ class DisaggRouter:
                           shared_host_tier=self.shared_tier,
                           tier_ledger_hook=(ledger_hook
                                             if self.shared_tier is None
-                                            else None))
+                                            else None),
+                          replica_label=replica_label)
             if dev is None:
                 return cls(params, config, ec, **kwargs)
             with jax.default_device(dev):
@@ -628,6 +630,24 @@ class DisaggRouter:
         self.prefill.pop_finished()
         self.decode.pop_finished()
         return done
+
+    # ------------------------------------------------------------------
+    # fleet routing probes (serving/fleet.py): a disagg pair is one
+    # replica — composition, not a special case.  Affinity is judged
+    # against the PREFILL trie (that is where a new prompt's prefix
+    # lands), load against both pools (a saturated decode side stalls
+    # streams just as surely as a saturated prefill side).
+    def prefix_match_len(self, tokens) -> int:
+        return self.prefill.prefix_match_len(tokens)
+
+    def load_probe(self) -> Dict[str, int]:
+        p = self.prefill.load_probe()
+        d = self.decode.load_probe()
+        return {
+            "queue_depth": p["queue_depth"] + len(self._tickets),
+            "free_slots": min(p["free_slots"], d["free_slots"]),
+            "free_blocks": p["free_blocks"] + d["free_blocks"],
+        }
 
     def warmup(self) -> None:
         self.prefill.warmup()

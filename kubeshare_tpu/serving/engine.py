@@ -501,8 +501,16 @@ class ServingEngine:
         pool_label: Optional[str] = None,
         shared_host_tier: Optional[HostTier] = None,
         tier_ledger_hook=None,
+        replica_label: Optional[str] = None,
+        mesh_devices=None,
     ) -> None:
         ec = engine_config or EngineConfig()
+        if mesh_devices is not None and ec.mesh_spec is None:
+            raise ValueError(
+                "mesh_devices requires mesh_spec — an unsharded engine "
+                "has no mesh to pin onto a device group; pin it with "
+                "jax.default_device + device_put instead (the fleet's "
+                "tp=1 build path does exactly that)")
         if ec.max_request_len > config.max_seq_len:
             raise ValueError(
                 f"max_request_len {ec.max_request_len} exceeds the model's "
@@ -580,7 +588,8 @@ class ServingEngine:
         # (never materialized replicated first).
         self._sharded = (ShardedServingContext(
             config, ec.mesh_spec, params,
-            long_context_threshold=ec.long_context_threshold)
+            long_context_threshold=ec.long_context_threshold,
+            devices=mesh_devices)
             if ec.mesh_spec is not None else None)
         if self._sharded is not None:
             params = self._sharded.place_params(params)
@@ -656,6 +665,10 @@ class ServingEngine:
         # with PREFILL-pool geometry), on_tier_demote(node, payload,
         # tenant) mirrors a demoted block into the peer pool's trie.
         self.pool_label = pool_label
+        # fleet surface (serving/fleet.py): replica_label tags this
+        # engine's per-request metric families (dispatch/TTFT/TBT) so
+        # the fleet's merged scrape stays per-replica attributable.
+        self.replica_label = replica_label
         self.on_handoff = None
         self.on_preempt_requeue = None
         self.on_tier_demote = None
@@ -1253,6 +1266,30 @@ class ServingEngine:
             del self._results[rid]
         return done
 
+    # ------------------------------------------------------------------
+    # fleet routing probes (serving/fleet.py) — both read-only, called
+    # against every replica per arrival, so neither may mutate engine
+    # state or touch the device.
+    def prefix_match_len(self, tokens) -> int:
+        """Tokens of ``tokens`` this engine's radix trie covers (device
+        or host tier) — 0 when prefix caching is off."""
+        if self.prefix_index is None:
+            return 0
+        return self.prefix_index.match_len(tokens)
+
+    def load_probe(self) -> Dict[str, int]:
+        """Cheap load snapshot for routing tie-breaks and spill
+        decisions: queue depth, free slots, and allocatable blocks
+        (free + cached-idle, since the allocator evicts cached blocks
+        on demand)."""
+        return {
+            "queue_depth": len(self._queue),
+            "free_slots": sum(1 for s in self._slots
+                              if s.state == "free"),
+            "free_blocks": (self.allocator.free_blocks
+                            + self.allocator.cached_idle_blocks),
+        }
+
     def _verify_ks(self) -> List[int]:
         """Every draft width the adaptive controller can reach: powers
         of two from 1 up to ``draft_len`` (the verify dispatch is then
@@ -1439,6 +1476,11 @@ class ServingEngine:
         plabel = {"pool": self.pool_label} if self.pool_label else {}
         if self._sharded is not None:
             plabel["tp"] = str(self._sharded.tp)
+        # ...and for fleets: each replica's engine tags the same
+        # families with a `replica` constant-label so the merged scrape
+        # stays per-replica attributable
+        if self.replica_label:
+            plabel["replica"] = self.replica_label
         dispatches = MetricFamily(
             "kubeshare_serving_dispatches_total",
             "Device dispatches by kind (mixed = one fused prefill "

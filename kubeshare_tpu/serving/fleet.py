@@ -1,0 +1,856 @@
+"""Replica fleet serving: prefix-affinity routing + placement control.
+
+Everything below :class:`ReplicaFleet` is one engine (or one disagg
+pair) on one mesh; this module is the cluster axis — N data-parallel
+REPLICAS behind one front end, the millions-of-users shape the source
+paper's control plane exists to serve (replicas x disagg x TP).  Three
+ideas, composed:
+
+- **Prefix-affinity routing.**  Each arrival probes every active
+  replica's radix trie through the read-only
+  :meth:`~kubeshare_tpu.serving.prefix_index.PrefixIndex.match_len`
+  (device- and host-tier-resident prefixes both count) and goes to the
+  replica holding the longest prefix at BLOCK granularity — ties and
+  zero-hit prompts fall back to least-loaded (free blocks + queue
+  depth).  Affinity never wins over QoS: a Guarantee request whose
+  affinity target would queue it spills to a replica with a free slot,
+  and any request spills off a saturated target.  Policies are
+  pluggable (:class:`RoutingPolicy`); the bench's control arm is
+  :class:`RoundRobinPolicy`.
+
+- **Drain-then-retire with cache inheritance.**  :meth:`drain` stops
+  admission to a replica and lets its lanes finish; at idle the fleet
+  snapshots the replica's whole radix trie (device blocks read back,
+  host entries probed without touching tier LRU) and re-inserts every
+  block into the SHARED host tier under each surviving replica's trie
+  (``PrefixIndex.adopt_host`` — the disagg cross-pool cache bus,
+  promoted to a cross-REPLICA bus), so a retired replica's cache is
+  inherited, not lost.  While replicas live, pressure-demoted blocks
+  mirror to siblings through the same bus.
+
+- **Placement + autoscaling as control-plane decisions.**  The fleet
+  accepts a placement plane (``place(name)`` / ``release(name)`` —
+  :class:`~kubeshare_tpu.scheduler.placement.FleetPlacementPlane`
+  renders a replica as a pod-shaped request through the KubeShare
+  Filter/Score/Reserve flow onto fractional cells) and a
+  :class:`ScalingPolicy` consulted every ``autoscale_every`` steps:
+  :class:`TTFTBreachPolicy` scales up on a sustained interval-TTFT-p95
+  breach and drains the least-loaded replica after sustained idleness,
+  with consecutive-cycle hysteresis so a bursty trace never flaps.
+
+Device placement rides the ``dp`` mesh axis a single engine rejects:
+``EngineConfig.mesh_spec`` with dp>1 is carved by
+:func:`~kubeshare_tpu.serving.sharded.carve_replica_groups` into
+per-replica tp device groups — replica i runs tp-sharded over its own
+``MeshSpec(dp=1, tp=tp)`` mesh (tp>1) or pinned to its group's single
+device (tp=1, the disagg build pattern).  A ``replica_factory`` swaps
+whole replicas for disagg pairs or anything engine-shaped —
+composition, not special cases.
+
+Streams stay BIT-EXACT with one monolithic engine at equal aggregate
+KV budget: a stream is deterministic in (prompt, budget, temperature,
+rng) regardless of which replica runs it or how scheduling interleaves
+— test- and bench-hard-asserted.  Zero recompiles per replica after
+warmup, same invariant as everywhere else in the serving stack.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, replace
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+
+from ..parallel.mesh import MeshSpec
+from ..utils.promtext import MetricFamily, Sample
+from .engine import (EngineConfig, Request, RequestResult, ServingEngine,
+                     TTFT_BUCKETS, _bucket_observe, _histogram_samples)
+from .kv_tier import HostTier, LRUTierPolicy, QoSTierPolicy
+from .qos import TenantRegistry
+from .sharded import carve_replica_groups
+
+# Drain-duration bucket bounds: a drain lasts as long as its slowest
+# in-flight lane (admission stops immediately), so healthy drains track
+# a request lifetime — seconds-scale slots are lanes that were just
+# admitted; the 30s+ tail is a stuck lane, not a drain.
+DRAIN_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+def _pool_engines(eng) -> list:
+    """The raw ServingEngine(s) behind a replica: the engine itself, or
+    a disagg pair's two pools — duck-typed so any engine-shaped replica
+    works."""
+    if hasattr(eng, "_ttft_counts"):
+        return [eng]
+    return [eng.prefill, eng.decode]
+
+
+def _interval_quantile(counts, q: float,
+                       bounds=TTFT_BUCKETS) -> Optional[float]:
+    """Histogram-bucket quantile over INTERVAL counts (the PromQL
+    ``histogram_quantile`` estimate, upper-bound flavored): None on an
+    empty interval; observations in the +Inf tail report as infinite —
+    any finite threshold treats that as a breach, which is the point."""
+    total = sum(counts)
+    if total == 0:
+        return None
+    rank = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if c and cum >= rank:
+            return float(bounds[i]) if i < len(bounds) else float("inf")
+    return float("inf")
+
+
+@dataclass
+class ReplicaHandle:
+    """One replica's lifecycle record.  ``state`` walks active ->
+    draining -> retired; the engine reference is kept after retirement
+    so ``compile_counts``/``collect_metrics`` still cover it (its
+    counters are final — a production deployment would drop the ref and
+    the device memory with it)."""
+
+    name: str
+    engine: object
+    state: str = "active"
+    group_idx: Optional[int] = None
+    uses_fleet_tier: bool = False
+    drain_started: Optional[float] = None
+    placement: object = None
+
+
+# ---------------------------------------------------------------------------
+# routing policies
+# ---------------------------------------------------------------------------
+
+class RoutingPolicy:
+    """Where does this arrival go?  ``route`` sees the fleet (for trie
+    probes and QoS lookups) and the ACTIVE replica handles; it returns
+    (handle, reason) where the reason lands in
+    ``kubeshare_serving_fleet_routing_decisions_total{reason=...}``.
+    Stateless policies are preferred; stateful ones (round-robin) own
+    their state."""
+
+    def route(self, fleet: "ReplicaFleet", request: Request,
+              candidates: List[ReplicaHandle]
+              ) -> Tuple[ReplicaHandle, str]:
+        raise NotImplementedError
+
+
+def _load_key(probe: Dict[str, int]) -> tuple:
+    # fewest queued first, then most free slots, then most allocatable
+    # blocks — the "free blocks + queue depth" tie-break from the trie's
+    # point of view
+    return (probe["queue_depth"], -probe["free_slots"],
+            -probe["free_blocks"])
+
+
+class PrefixAffinityPolicy(RoutingPolicy):
+    """Longest-cached-prefix wins, at block granularity; least-loaded
+    breaks ties and takes zero-hit prompts; saturation and Guarantee
+    QoS spill.
+
+    ``spill_queue_depth``: a replica with no free slot AND at least
+    this many queued requests is saturated — an affinity win there
+    would buy cached blocks at the price of queueing behind that many
+    admissions, a bad trade for any request.  Guarantee traffic is
+    stricter still: it spills as soon as the affinity target would
+    queue it at all (no free slot) while any candidate has one — the
+    affinity discount never outranks the QoS contract."""
+
+    def __init__(self, spill_queue_depth: int = 2) -> None:
+        if spill_queue_depth < 1:
+            raise ValueError(
+                f"spill_queue_depth must be >= 1, got {spill_queue_depth}")
+        self.spill_queue_depth = spill_queue_depth
+
+    def route(self, fleet, request, candidates):
+        probes = {h.name: h.engine.load_probe() for h in candidates}
+        least_loaded = min(
+            candidates, key=lambda h: (_load_key(probes[h.name]), h.name))
+        bs = fleet.block_size
+        blocks = {h.name: h.engine.prefix_match_len(request.prompt) // bs
+                  for h in candidates}
+        best = max(blocks.values())
+        if best <= 0:
+            return least_loaded, "least_loaded"
+        winner = min((h for h in candidates if blocks[h.name] == best),
+                     key=lambda h: (_load_key(probes[h.name]), h.name))
+
+        def saturated(h):
+            p = probes[h.name]
+            return (p["free_slots"] == 0
+                    and p["queue_depth"] >= self.spill_queue_depth)
+
+        wp = probes[winner.name]
+        if fleet.tenants.get(request.tenant).is_guarantee \
+                and wp["free_slots"] == 0:
+            with_slot = [h for h in candidates
+                         if probes[h.name]["free_slots"] > 0]
+            if with_slot:
+                return min(with_slot,
+                           key=lambda h: (_load_key(probes[h.name]),
+                                          h.name)), "spill"
+        if saturated(winner):
+            open_ = [h for h in candidates if not saturated(h)]
+            if open_:
+                return min(open_,
+                           key=lambda h: (_load_key(probes[h.name]),
+                                          h.name)), "spill"
+        return winner, "affinity"
+
+
+class RoundRobinPolicy(RoutingPolicy):
+    """Cache-blind rotation over the active set — the bench's control
+    arm: whatever prefix-skip rate this achieves is what replica
+    placement gives you for free, and the affinity policy's margin over
+    it is the router's whole contribution."""
+
+    def __init__(self) -> None:
+        self._next = 0
+
+    def route(self, fleet, request, candidates):
+        handle = candidates[self._next % len(candidates)]
+        self._next += 1
+        return handle, "round_robin"
+
+
+# ---------------------------------------------------------------------------
+# scaling policies
+# ---------------------------------------------------------------------------
+
+class ScalingPolicy:
+    """Consulted every ``autoscale_every`` fleet steps: return ``"up"``
+    to add a replica, ``"down"`` to drain the least-loaded one,
+    ``"down:<name>"`` to drain a specific one, None to hold.  The fleet
+    clamps to [min_replicas, max_replicas] and to the carved device
+    groups — a policy never has to know the device budget."""
+
+    def decide(self, fleet: "ReplicaFleet") -> Optional[str]:
+        return None
+
+
+class TTFTBreachPolicy(ScalingPolicy):
+    """Scale up on sustained TTFT p95 breach, drain on sustained idle.
+
+    Each ``decide`` diffs the fleet's cumulative TTFT histogram counts
+    (all non-retired replicas, merged) against the previous call's
+    snapshot — an INTERVAL histogram of just the TTFTs observed since
+    the last tick — and estimates its p95.  ``breach_cycles``
+    consecutive breached intervals (each with at least ``min_samples``
+    observations) trigger one scale-up; ``idle_cycles`` consecutive
+    empty-and-idle intervals trigger one drain.  Both streaks reset to
+    zero after firing and on any contrary observation, so a bursty
+    trace that alternates breach/ok intervals never flaps the fleet —
+    the hysteresis the tests pin down."""
+
+    def __init__(self, threshold_s: float, *, breach_cycles: int = 3,
+                 idle_cycles: int = 3, min_samples: int = 4,
+                 quantile: float = 0.95) -> None:
+        if threshold_s <= 0:
+            raise ValueError(
+                f"threshold_s must be > 0, got {threshold_s}")
+        if breach_cycles < 1 or idle_cycles < 1:
+            raise ValueError(
+                f"breach_cycles/idle_cycles must be >= 1, got "
+                f"{breach_cycles}/{idle_cycles}")
+        if min_samples < 1:
+            raise ValueError(
+                f"min_samples must be >= 1, got {min_samples}")
+        if not (0.0 < quantile < 1.0):
+            raise ValueError(
+                f"quantile must be in (0, 1), got {quantile}")
+        self.threshold_s = threshold_s
+        self.breach_cycles = breach_cycles
+        self.idle_cycles = idle_cycles
+        self.min_samples = min_samples
+        self.quantile = quantile
+        self._prev: Optional[List[int]] = None
+        self._breaches = 0
+        self._idle = 0
+
+    def decide(self, fleet):
+        snap = fleet._ttft_counts_snapshot()
+        prev = self._prev if self._prev is not None else [0] * len(snap)
+        self._prev = snap
+        interval = [a - b for a, b in zip(snap, prev)]
+        n = sum(interval)
+        if n >= self.min_samples:
+            p = _interval_quantile(interval, self.quantile)
+            if p is not None and p > self.threshold_s:
+                self._breaches += 1
+                self._idle = 0
+            else:
+                self._breaches = 0
+        elif n == 0 and fleet.idle:
+            self._idle += 1
+            self._breaches = 0
+        else:
+            # a thin or busy interval is evidence of neither overload
+            # nor idleness — break both streaks rather than guess
+            self._breaches = 0
+            self._idle = 0
+        if self._breaches >= self.breach_cycles:
+            self._breaches = 0
+            self._idle = 0
+            return "up"
+        if self._idle >= self.idle_cycles:
+            self._idle = 0
+            self._breaches = 0
+            return "down"
+        return None
+
+
+# ---------------------------------------------------------------------------
+# the fleet
+# ---------------------------------------------------------------------------
+
+class ReplicaFleet:
+    """N replica engines behind a prefix-affinity router — the
+    engine-shaped front end over the ``dp`` axis (submit / step / run /
+    idle / result / pop_finished / warmup / compile_counts /
+    collect_metrics, same surface as one engine or a disagg pair).
+
+    ``engine_config`` is the PER-REPLICA geometry (so a fleet of 2 at
+    equal aggregate budget with a monolithic ``num_blocks=2B+1`` engine
+    runs each replica at ``num_blocks=B+1`` — block 0 is scratch in
+    every pool).  ``shared_tier_bytes`` stands up ONE host tier under
+    every replica's trie: the cross-replica cache bus that drains and
+    pressure-demotes travel over.  ``placement`` is any object with
+    ``place(name)`` / ``release(name)`` (see
+    scheduler/placement.py); ``replica_factory(name, devices,
+    shared_host_tier, tenants)`` swaps whole replicas (a disagg pair is
+    one replica) — factory replicas that keep their own tier opt out of
+    the fleet bus and its drain inheritance."""
+
+    def __init__(
+        self,
+        params,
+        config,
+        engine_config: Optional[EngineConfig] = None,
+        *,
+        replicas: int = 2,
+        min_replicas: int = 1,
+        max_replicas: Optional[int] = None,
+        guard=None,
+        tenants: Optional[TenantRegistry] = None,
+        routing: Optional[RoutingPolicy] = None,
+        scaling: Optional[ScalingPolicy] = None,
+        autoscale_every: int = 50,
+        placement=None,
+        shared_tier_bytes: Optional[int] = None,
+        tier_policy: str = "lru",
+        ledger_hook=None,
+        replica_factory: Optional[Callable] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if min_replicas < 1 or min_replicas > replicas:
+            raise ValueError(
+                f"min_replicas must be in [1, replicas={replicas}], "
+                f"got {min_replicas}")
+        if max_replicas is not None and max_replicas < replicas:
+            raise ValueError(
+                f"max_replicas {max_replicas} is below the initial "
+                f"fleet size {replicas}")
+        if autoscale_every < 1:
+            raise ValueError(
+                f"autoscale_every must be >= 1, got {autoscale_every}")
+        self.params = params
+        self.model_config = config
+        self.engine_config = engine_config or EngineConfig()
+        self.tenants = tenants or TenantRegistry.default()
+        self.routing = routing or PrefixAffinityPolicy()
+        self.scaling = scaling
+        self.autoscale_every = autoscale_every
+        self.placement = placement
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self._guard = guard
+        self._replica_factory = replica_factory
+        self._ledger_hook = ledger_hook
+        self._clock = clock
+        # each replica serves ~1/N of the traffic, so each gets a 1/N
+        # view of every tenant's KV quota (scale-ups reuse the same
+        # fraction: the aggregate contract loosens as the fleet grows,
+        # which is what growing the fleet is FOR)
+        self._quota_fraction = 1.0 / replicas
+
+        self.shared_tier: Optional[HostTier] = None
+        if shared_tier_bytes is not None:
+            if tier_policy not in ("lru", "qos"):
+                raise ValueError(
+                    f"tier_policy must be 'lru' or 'qos', got "
+                    f"{tier_policy!r}")
+            policy = (LRUTierPolicy() if tier_policy == "lru"
+                      else QoSTierPolicy(self.tenants))
+            self.shared_tier = HostTier(shared_tier_bytes, policy,
+                                        on_drop=self._route_drop,
+                                        ledger_hook=ledger_hook)
+
+        # dp carving: a dp>1 mesh_spec names this fleet's device budget
+        self._groups: Optional[List[list]] = None
+        self._free_groups: List[int] = []
+        if self.engine_config.mesh_spec is not None:
+            self._groups = carve_replica_groups(self.engine_config.mesh_spec)
+            if replicas > len(self._groups):
+                raise ValueError(
+                    f"replicas={replicas} exceeds the "
+                    f"{len(self._groups)} device group(s) carved from "
+                    f"mesh_spec {self.engine_config.mesh_spec}")
+            if max_replicas is not None \
+                    and max_replicas > len(self._groups):
+                raise ValueError(
+                    f"max_replicas={max_replicas} exceeds the "
+                    f"{len(self._groups)} device group(s) carved from "
+                    f"mesh_spec {self.engine_config.mesh_spec} — the "
+                    f"autoscaler cannot conjure devices")
+            self._free_groups = list(range(len(self._groups)))[::-1]
+
+        self._replicas: List[ReplicaHandle] = []
+        self._next_idx = 0
+        self._owner: Dict[str, str] = {}
+        self._results: Dict[str, RequestResult] = {}
+        self._steps = 0
+        self.routing_decisions: Dict[str, int] = {
+            "affinity": 0, "least_loaded": 0, "spill": 0}
+        self.scale_events: Dict[str, int] = {"up": 0, "down": 0}
+        self._drain_counts = [0] * (len(DRAIN_BUCKETS) + 1)
+        self._drain_sum = 0.0
+        for _ in range(replicas):
+            self._add_replica(count_event=False)
+
+    # ------------------------------------------------------------------
+    # replica lifecycle
+    # ------------------------------------------------------------------
+    @property
+    def replicas(self) -> List[ReplicaHandle]:
+        return list(self._replicas)
+
+    def _active(self) -> List[ReplicaHandle]:
+        return [h for h in self._replicas if h.state == "active"]
+
+    def _handle(self, name: str) -> ReplicaHandle:
+        for h in self._replicas:
+            if h.name == name:
+                return h
+        raise KeyError(
+            f"unknown replica {name!r} (have: "
+            f"{[h.name for h in self._replicas]})")
+
+    @property
+    def block_size(self) -> int:
+        return self.engine_config.block_size
+
+    def _add_replica(self, count_event: bool, warmup: bool = False
+                     ) -> ReplicaHandle:
+        group_idx = None
+        devices = None
+        if self._groups is not None:
+            if not self._free_groups:
+                raise RuntimeError(
+                    f"dp carve exhausted: all {len(self._groups)} "
+                    f"device groups hold replicas — the fleet cannot "
+                    f"grow past dp")
+            group_idx = self._free_groups.pop()
+            devices = self._groups[group_idx]
+        name = f"r{self._next_idx}"
+        self._next_idx += 1
+        view = self.tenants.pool_view(self._quota_fraction)
+        if self._replica_factory is not None:
+            eng = self._replica_factory(name, devices, self.shared_tier,
+                                        view)
+            uses_tier = (self.shared_tier is not None
+                         and getattr(eng, "host_tier", None)
+                         is self.shared_tier)
+        else:
+            eng = self._build_engine(name, devices, view)
+            uses_tier = self.shared_tier is not None
+        handle = ReplicaHandle(name=name, engine=eng, group_idx=group_idx,
+                               uses_fleet_tier=uses_tier)
+        if uses_tier:
+            eng.on_tier_demote = self._mirror_from(handle)
+        if self.placement is not None:
+            handle.placement = self.placement.place(name)
+        self._replicas.append(handle)
+        if warmup:
+            eng.warmup()
+        if count_event:
+            self.scale_events["up"] += 1
+        return handle
+
+    def _build_engine(self, name: str, devices, view: TenantRegistry):
+        base = self.engine_config
+        kwargs = dict(guard=self._guard, tenants=view, replica_label=name,
+                      shared_host_tier=self.shared_tier,
+                      tier_ledger_hook=(self._ledger_hook
+                                        if self.shared_tier is None
+                                        else None))
+        if devices is not None and len(devices) > 1:
+            # tp-sharded replica: a private dp=1 mesh over exactly this
+            # group — the engine's sharded context builds the mesh and
+            # commits the pool to it, so no extra pinning is needed
+            ec = replace(base, mesh_spec=MeshSpec(
+                dp=1, tp=len(devices), sp=1))
+            return ServingEngine(self.params, self.model_config, ec,
+                                 mesh_devices=list(devices), **kwargs)
+        ec = replace(base, mesh_spec=None)
+        if devices is None:
+            return ServingEngine(self.params, self.model_config, ec,
+                                 **kwargs)
+        dev = devices[0]
+        with jax.default_device(dev):
+            eng = ServingEngine(jax.device_put(self.params, dev),
+                                self.model_config, ec, **kwargs)
+        # commit the freshly initialised KV slabs to the replica's
+        # device: step outputs are committed arrays, so an uncommitted
+        # initial pool would give the first warmup compile of each
+        # program a different jit cache key than every later dispatch —
+        # a guaranteed recompile after warmup (the disagg build pattern)
+        eng.pool = replace(eng.pool,
+                           k=jax.device_put(eng.pool.k, dev),
+                           v=jax.device_put(eng.pool.v, dev))
+        return eng
+
+    def scale_up(self, *, warmup: bool = True) -> ReplicaHandle:
+        """Add one replica (placed, tier-wired, warmed).  Loud when the
+        fleet is at max_replicas or out of device groups — the
+        autoscaler pre-checks :meth:`can_grow` instead of catching."""
+        live = sum(1 for h in self._replicas if h.state != "retired")
+        if self.max_replicas is not None and live >= self.max_replicas:
+            raise RuntimeError(
+                f"fleet is at max_replicas={self.max_replicas} "
+                f"({live} live replicas)")
+        return self._add_replica(count_event=True, warmup=warmup)
+
+    def can_grow(self) -> bool:
+        live = sum(1 for h in self._replicas if h.state != "retired")
+        if self.max_replicas is not None and live >= self.max_replicas:
+            return False
+        if self._groups is not None and not self._free_groups:
+            return False
+        return True
+
+    def drain(self, name: str) -> None:
+        """Stop admission to ``name`` and let its lanes finish; the
+        step loop retires it at idle, handing its trie to the shared
+        tier so siblings inherit the cache.  Refuses to shrink the
+        active set below ``min_replicas``."""
+        handle = self._handle(name)
+        if handle.state != "active":
+            raise ValueError(
+                f"replica {name!r} is {handle.state}, not active")
+        if len(self._active()) - 1 < self.min_replicas:
+            raise RuntimeError(
+                f"draining {name!r} would leave "
+                f"{len(self._active()) - 1} active replicas, below "
+                f"min_replicas={self.min_replicas}")
+        handle.state = "draining"
+        handle.drain_started = self._clock()
+        self.scale_events["down"] += 1
+
+    def _finish_drains(self) -> None:
+        for handle in self._replicas:
+            if handle.state != "draining" or not handle.engine.idle:
+                continue
+            dur = max(0.0, self._clock() - handle.drain_started)
+            _bucket_observe(self._drain_counts, dur, DRAIN_BUCKETS)
+            self._drain_sum += dur
+            self._handoff_trie(handle)
+            handle.state = "retired"
+            if self.placement is not None:
+                self.placement.release(handle.name)
+            if handle.group_idx is not None:
+                self._free_groups.append(handle.group_idx)
+
+    # ------------------------------------------------------------------
+    # the cross-replica cache bus
+    # ------------------------------------------------------------------
+    def _mirror_from(self, handle: ReplicaHandle):
+        """A replica's ``on_tier_demote`` hook: when it demotes a block
+        into the shared tier, insert an independent payload copy under
+        each ACTIVE sibling's trie (the disagg cross-pool mirror, one
+        copy per peer).  A refused put ends the loop — the tier is
+        telling us it has no budget for more mirrors."""
+        def on_demote(node, payload: bytes, tenant) -> None:
+            src = handle.engine.prefix_index
+            tokens = src.path_tokens(node)
+            for peer in self._replicas:
+                if peer is handle or peer.state != "active" \
+                        or not peer.uses_fleet_tier:
+                    continue
+                key = self.shared_tier.put(payload, tenant, None)
+                if key is None:
+                    return
+                adopted = peer.engine.prefix_index.adopt_host(tokens, key)
+                if adopted is None:
+                    self.shared_tier.forget(key)
+                else:
+                    self.shared_tier.bind_node(key, adopted)
+        return on_demote
+
+    def _handoff_trie(self, handle: ReplicaHandle) -> None:
+        """Drain completion: move the retiring replica's whole radix
+        trie into the shared tier under every surviving trie.  The walk
+        SNAPSHOTS first (device payloads read back, host payloads
+        probed without LRU touches), then forgets the retiree's own
+        tier entries (their budget funds the mirrors), then re-inserts
+        breadth-first — BFS guarantees every node's full-block ancestors
+        were adopted before ``adopt_host`` checks for them."""
+        if self.shared_tier is None or not handle.uses_fleet_tier:
+            return
+        eng = handle.engine
+        idx = getattr(eng, "prefix_index", None)
+        if idx is None:
+            return
+        entries: List[tuple] = []  # (path_tokens, payload, tenant)
+        own_keys: List[int] = []
+        queue = list(idx._root.children.values()) + list(idx._root.partials)
+        i = 0
+        while i < len(queue):
+            node = queue[i]
+            i += 1
+            tokens = idx.path_tokens(node)
+            if node.host_key is not None:
+                entry = self.shared_tier.probe(node.host_key)
+                if entry is not None:
+                    entries.append((tokens, entry.payload, entry.tenant))
+                    own_keys.append(node.host_key)
+            else:
+                tenant = eng.allocator._tenant_of.get(node.block)
+                entries.append(
+                    (tokens, eng._read_block_payload(node), tenant))
+            queue.extend(list(node.children.values()) + node.partials)
+        for key in own_keys:
+            self.shared_tier.forget(key)
+        peers = [p for p in self._replicas
+                 if p is not handle and p.state == "active"
+                 and p.uses_fleet_tier]
+        for tokens, payload, tenant in entries:
+            for peer in peers:
+                key = self.shared_tier.put(payload, tenant, None)
+                if key is None:
+                    continue
+                adopted = peer.engine.prefix_index.adopt_host(tokens, key)
+                if adopted is None:
+                    self.shared_tier.forget(key)
+                else:
+                    self.shared_tier.bind_node(key, adopted)
+
+    def _route_drop(self, entry) -> None:
+        """Shared tier's budget-eviction hook: route the dying entry to
+        whichever live replica's trie holds its node (a mirror evicted
+        before ``bind_node`` has no trie presence — nothing to
+        detach)."""
+        if entry.node is None:
+            return
+        for handle in self._replicas:
+            if handle.state == "retired" or not handle.uses_fleet_tier:
+                continue
+            if handle.engine.prefix_index.owns(entry.node):
+                handle.engine._drop_host_entry(entry)
+                return
+
+    # ------------------------------------------------------------------
+    # the engine-shaped surface
+    # ------------------------------------------------------------------
+    def submit(self, request: Request) -> RequestResult:
+        candidates = self._active()
+        if not candidates:
+            raise RuntimeError(
+                "fleet has no active replicas to route to")
+        handle, reason = self.routing.route(self, request, candidates)
+        if handle.state != "active":
+            raise RuntimeError(
+                f"routing policy {type(self.routing).__name__} picked "
+                f"non-active replica {handle.name!r} ({handle.state})")
+        self.routing_decisions[reason] = \
+            self.routing_decisions.get(reason, 0) + 1
+        result = handle.engine.submit(request)
+        self._owner[request.rid] = handle.name
+        self._results[request.rid] = result
+        return result
+
+    def step(self) -> bool:
+        """One fleet iteration: advance every live replica, retire any
+        drain that completed, and consult the scaling policy on its
+        cadence.  Returns False only when every live replica is
+        drained-and-idle."""
+        worked = False
+        for handle in self._replicas:
+            if handle.state == "retired":
+                continue
+            worked |= handle.engine.step()
+        self._finish_drains()
+        self._steps += 1
+        if self.scaling is not None \
+                and self._steps % self.autoscale_every == 0:
+            self._autoscale_tick()
+        return worked
+
+    def _autoscale_tick(self) -> None:
+        decision = self.scaling.decide(self)
+        if decision is None:
+            return
+        if decision == "up":
+            if self.can_grow():
+                self.scale_up()
+            return
+        if decision == "down" or decision.startswith("down:"):
+            active = self._active()
+            if len(active) - 1 < self.min_replicas:
+                return
+            if ":" in decision:
+                name = decision.split(":", 1)[1]
+            else:
+                probes = {h.name: h.engine.load_probe() for h in active}
+                name = min(active,
+                           key=lambda h: (_load_key(probes[h.name]),
+                                          h.name)).name
+            self.drain(name)
+            return
+        raise ValueError(
+            f"scaling policy returned {decision!r} — expected 'up', "
+            f"'down', 'down:<name>' or None")
+
+    def run(self) -> Dict[str, RequestResult]:
+        """Drain everything; returns results by request id."""
+        try:
+            while self.step():
+                pass
+        finally:
+            done = set()
+            for handle in self._replicas:
+                for eng in _pool_engines(handle.engine):
+                    if eng.guard is not None \
+                            and id(eng.guard) not in done:
+                        done.add(id(eng.guard))
+                        eng.guard.finish()
+        return dict(self._results)
+
+    @property
+    def idle(self) -> bool:
+        return all(h.engine.idle for h in self._replicas
+                   if h.state != "retired")
+
+    def result(self, rid: str) -> RequestResult:
+        return self._results[rid]
+
+    def owner_of(self, rid: str) -> str:
+        """Which replica a request was routed to (sticks after the
+        replica retires) — observability and test hook."""
+        return self._owner[rid]
+
+    def pop_finished(self) -> Dict[str, RequestResult]:
+        done = {rid: r for rid, r in self._results.items() if r.done}
+        for rid in done:
+            del self._results[rid]
+            del self._owner[rid]
+        for handle in self._replicas:
+            handle.engine.pop_finished()
+        return done
+
+    def warmup(self) -> None:
+        for handle in self._replicas:
+            if handle.state != "retired":
+                handle.engine.warmup()
+
+    def compile_counts(self) -> Dict[str, int]:
+        """Every replica's jit cache sizes, keys prefixed with the
+        replica name (retired replicas included — their counts are
+        frozen, so any post-warmup growth is a live recompile)."""
+        counts: Dict[str, int] = {}
+        for handle in self._replicas:
+            for k, v in handle.engine.compile_counts().items():
+                counts[f"{handle.name}.{k}"] = v
+        return counts
+
+    def _ttft_counts_snapshot(self) -> List[int]:
+        """Cumulative TTFT bucket counts merged over every non-retired
+        replica — the autoscaler's interval-diff raw material."""
+        counts = [0] * (len(TTFT_BUCKETS) + 1)
+        for handle in self._replicas:
+            if handle.state == "retired":
+                continue
+            for eng in _pool_engines(handle.engine):
+                for i, c in enumerate(eng._ttft_counts):
+                    counts[i] += c
+        return counts
+
+    # ------------------------------------------------------------------
+    def collect_metrics(self) -> List[MetricFamily]:
+        """Every replica's families merged (the ``replica`` label keeps
+        per-request series distinct; unlabeled counters sum), plus the
+        fleet's own families.  The shared tier's store-level series —
+        its byte gauges and the ``host_evicted`` counter — are reported
+        once, not once per replica reading the same store; replicas
+        with private tiers (factory-built disagg pairs) still sum."""
+        merged: Dict[str, MetricFamily] = {}
+        seen_shared = False
+        for handle in self._replicas:
+            dedup = (self.shared_tier is not None
+                     and handle.uses_fleet_tier and seen_shared)
+            if self.shared_tier is not None and handle.uses_fleet_tier:
+                seen_shared = True
+            for fam in handle.engine.collect_metrics():
+                if dedup:
+                    if fam.name == "kubeshare_serving_tier_host_bytes":
+                        continue
+                    if fam.name == "kubeshare_serving_tier_blocks_total":
+                        fam.samples = [
+                            s for s in fam.samples
+                            if s.labels.get("event") != "host_evicted"]
+                have = merged.get(fam.name)
+                if have is None:
+                    merged[fam.name] = fam
+                    continue
+                self._merge_samples(have, fam)
+        states = {"active": 0, "draining": 0, "retired": 0}
+        for handle in self._replicas:
+            states[handle.state] += 1
+        replicas = MetricFamily(
+            "kubeshare_serving_fleet_replicas",
+            "Replicas by lifecycle state", kind="gauge")
+        for state, n in states.items():
+            replicas.add({"state": state}, n)
+        routing = MetricFamily(
+            "kubeshare_serving_fleet_routing_decisions_total",
+            "Routing decisions by reason (affinity = longest cached "
+            "prefix won; least_loaded = no cached prefix anywhere, or "
+            "tie; spill = affinity target saturated or a Guarantee "
+            "request would have queued there)")
+        for reason, n in sorted(self.routing_decisions.items()):
+            routing.add({"reason": reason}, n)
+        scale = MetricFamily(
+            "kubeshare_serving_fleet_scale_events_total",
+            "Fleet size changes by direction (up = replica added, "
+            "down = drain initiated)")
+        for direction, n in sorted(self.scale_events.items()):
+            scale.add({"direction": direction}, n)
+        drain = MetricFamily(
+            "kubeshare_serving_fleet_drain_seconds",
+            "Drain duration: admission stop to retirement (the slowest "
+            "in-flight lane's remaining lifetime)", kind="histogram")
+        _histogram_samples(drain, "kubeshare_serving_fleet_drain_seconds",
+                           {}, self._drain_counts, self._drain_sum,
+                           DRAIN_BUCKETS)
+        return list(merged.values()) + [replicas, routing, scale, drain]
+
+    @staticmethod
+    def _merge_samples(dst: MetricFamily, src: MetricFamily) -> None:
+        index = {(s.name, tuple(sorted(s.labels.items()))): s
+                 for s in dst.samples}
+        for s in src.samples:
+            key = (s.name, tuple(sorted(s.labels.items())))
+            have = index.get(key)
+            if have is None:
+                dst.samples.append(s)
+                index[key] = s
+            else:
+                merged = Sample(have.name, have.labels,
+                                have.value + s.value)
+                dst.samples[dst.samples.index(have)] = merged
+                index[key] = merged

@@ -430,6 +430,14 @@ class HostTier:
         self._entries.move_to_end(key)
         return entry
 
+    def probe(self, key: int) -> Optional[HostEntry]:
+        """Read an entry WITHOUT touching LRU recency, or None when the
+        key is gone.  The fleet's drain snapshot (serving/fleet.py)
+        walks a retiring replica's trie through here — reordering the
+        victim tier's eviction queue mid-walk would make the handoff
+        evict what it is about to copy."""
+        return self._entries.get(key)
+
     def take(self, key: int) -> HostEntry:
         """Remove and return an entry — promotion moved its bytes back
         into a device block; the host copy is surplus."""

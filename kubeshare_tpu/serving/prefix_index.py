@@ -188,6 +188,34 @@ class PrefixIndex:
             pos += best
         return pos, chain
 
+    def match_len(self, tokens) -> int:
+        """Read-only routing probe: how many leading tokens this trie
+        covers, device- OR host-resident — the :meth:`match_tiered`
+        walk with no chain built and no state touched.  The fleet
+        router (serving/fleet.py) calls this against EVERY replica per
+        arrival, so it must stay allocation-light and side-effect-free
+        (no LRU touches, no promotion)."""
+        bs = self.block_size
+        toks = [int(t) for t in tokens]
+        node = self._root
+        pos = 0
+        while len(toks) - pos >= bs:
+            child = node.children.get(tuple(toks[pos: pos + bs]))
+            if child is None:
+                break
+            pos += bs
+            node = child
+        rem = toks[pos:]
+        best = 0
+        if rem:
+            for child in list(node.children.values()) + node.partials:
+                if child.tokens[0] != rem[0]:
+                    continue
+                l = _lcp(child.tokens, rem)
+                if l > best:
+                    best = l
+        return pos + best
+
     def continuation(self, tokens, limit: int) -> List[int]:
         """Cached tokens that previously FOLLOWED ``tokens``: when the
         whole sequence lies on one trie path, returns up to ``limit``

@@ -68,7 +68,7 @@ construction, applied to the paged chunk.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -364,6 +364,48 @@ def _local_verify_span(params, cfg, dec, pick_fn, pool_k, pool_v, tables,
 # the context: mesh + placement + shard_map twins of every entry point
 # ---------------------------------------------------------------------------
 
+def carve_replica_groups(mesh_spec: MeshSpec, devices=None) -> List[list]:
+    """Resolve a ``dp > 1`` serving :class:`MeshSpec` into per-replica
+    tp device groups — the fleet's side of the dp axis.
+
+    A single engine never runs dp (slots are its batch axis); instead
+    the fleet (serving/fleet.py) stands up ``dp`` engines and hands
+    replica ``i`` the contiguous device slice ``[i*tp, (i+1)*tp)``.
+    Each group then backs either a plain engine pinned to its one
+    device (tp=1) or a tensor-parallel engine whose private
+    ``MeshSpec(dp=1, tp=tp)`` mesh is built over exactly that group.
+    ``dp=-1`` fills: as many replicas as the device count covers.
+    Pure list slicing — no mesh is built here, so validation tests run
+    on any device count (including one CPU device with dp probed
+    against an explicit ``devices`` list)."""
+    if mesh_spec.ep != 1 or mesh_spec.sp != 1:
+        raise ValueError(
+            f"carve_replica_groups carves dp x tp only: mesh_spec must "
+            f"have ep=sp=1, got {mesh_spec}")
+    tp = mesh_spec.tp
+    if tp < 1:
+        raise ValueError(
+            f"carve_replica_groups needs an explicit tp >= 1 (the "
+            f"per-replica mesh width cannot be inferred), got tp={tp}")
+    avail = list(devices) if devices is not None else list(jax.devices())
+    dp = mesh_spec.dp
+    if dp == -1:
+        dp = len(avail) // tp
+        if dp < 1:
+            raise ValueError(
+                f"mesh_spec {mesh_spec} fills dp from {len(avail)} "
+                f"device(s) but tp={tp} does not fit even once")
+    elif dp < 1:
+        raise ValueError(
+            f"dp must be >= 1 or -1 (fill), got dp={dp}")
+    need = dp * tp
+    if len(avail) < need:
+        raise ValueError(
+            f"mesh_spec {mesh_spec} needs {need} devices "
+            f"({dp} replicas x tp={tp}), only {len(avail)} available")
+    return [avail[i * tp: (i + 1) * tp] for i in range(dp)]
+
+
 class ShardedServingContext:
     """Everything the engine needs to run its dispatches tensor-parallel.
 
@@ -386,10 +428,13 @@ class ShardedServingContext:
     ) -> None:
         if mesh_spec.dp != 1 or mesh_spec.ep != 1 or mesh_spec.sp != 1:
             raise ValueError(
-                f"serving shards tensor-parallel only: mesh_spec must "
-                f"have dp=ep=sp=1 (replicate the ENGINE for data "
-                f"parallelism — slots are the batch axis), got "
-                f"{mesh_spec}")
+                f"a SINGLE engine shards tensor-parallel only: "
+                f"mesh_spec must have dp=ep=sp=1 (slots are the batch "
+                f"axis inside one engine), got {mesh_spec} — dp > 1 is "
+                f"the replica axis: hand this spec to "
+                f"serving/fleet.ReplicaFleet, which carves it into "
+                f"per-replica tp device groups via "
+                f"carve_replica_groups and runs one engine per group")
         if (long_context_threshold is not None
                 and long_context_threshold < 1):
             raise ValueError(

@@ -1,0 +1,620 @@
+"""Replica fleet tests: prefix-affinity routing, drain-then-retire cache
+inheritance, autoscaler hysteresis, placement carving.
+
+The contract mirrors the serving stack's strongest invariant one level
+up: a fleet of N replicas at equal AGGREGATE KV budget must emit
+exactly the streams one monolithic engine emits — per request, greedy
+and sampled, across prefix hits and preemption, regardless of which
+replica the router picked.  On top of that the fleet's own value
+propositions are pinned: affinity routes to cached prefixes (and
+measurably beats round-robin), a drained replica's trie survives in the
+shared host tier for siblings to promote from, the autoscaler never
+flaps on a bursty trace, and nothing recompiles after warmup.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeshare_tpu.models.transformer import TransformerConfig, transformer_init
+
+pytestmark = pytest.mark.serving
+
+
+def _small_config(**extra):
+    return TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32, attention="reference", **extra)
+
+
+def _fleet(params, config, *, replicas=2, num_blocks=21, **overrides):
+    """A fleet whose per-replica pools sum to the monolithic budget:
+    ``replicas`` pools of ``num_blocks`` (each with its own scratch
+    block 0) aggregate to ``replicas * (num_blocks - 1)`` allocatable
+    blocks — pair with :func:`_mono`'s ``num_blocks`` accordingly."""
+    from kubeshare_tpu.serving import EngineConfig, ReplicaFleet
+
+    ec_kwargs = dict(num_slots=3, block_size=4, num_blocks=num_blocks,
+                     max_request_len=48, prefill_chunk=8)
+    fleet_kwargs = dict(replicas=replicas)
+    for k in ("routing", "scaling", "autoscale_every", "tenants",
+              "shared_tier_bytes", "min_replicas", "max_replicas",
+              "clock", "placement"):
+        if k in overrides:
+            fleet_kwargs[k] = overrides.pop(k)
+    ec_kwargs.update(overrides)
+    return ReplicaFleet(params, config, EngineConfig(**ec_kwargs),
+                        **fleet_kwargs)
+
+
+def _mono(params, config, *, num_blocks=41, **overrides):
+    from kubeshare_tpu.serving import EngineConfig, ServingEngine
+
+    kwargs = dict(num_slots=3, block_size=4, num_blocks=num_blocks,
+                  max_request_len=48, prefill_chunk=8)
+    tenants = overrides.pop("tenants", None)
+    kwargs.update(overrides)
+    return ServingEngine(params, config, EngineConfig(**kwargs),
+                         tenants=tenants)
+
+
+def _metric(families, name, **labels):
+    """Sum of samples named ``name`` matching ``labels`` on the given
+    keys (extra labels ignored; matches histogram suffix samples like
+    ``*_count`` that live inside a shorter-named family)."""
+    total = 0.0
+    for fam in families:
+        for s in fam.samples:
+            if s.name == name and all(
+                    s.labels.get(k) == v for k, v in labels.items()):
+                total += s.value
+    return total
+
+
+def _shared_prefix_trace(n_groups=3, per_group=4, prefix_len=12,
+                         tail_len=4, max_new=5, seed=3):
+    """Requests in ``n_groups`` families sharing a ``prefix_len``-token
+    prefix each — the workload affinity routing exists for."""
+    from kubeshare_tpu.serving import Request
+
+    rng = np.random.default_rng(seed)
+    prefixes = [rng.integers(0, 64, prefix_len) for _ in range(n_groups)]
+    reqs = []
+    for i in range(n_groups * per_group):
+        g = i % n_groups
+        tail = rng.integers(0, 64, tail_len)
+        reqs.append(Request(
+            f"g{g}x{i}",
+            np.concatenate([prefixes[g], tail]).astype(np.int64),
+            max_new))
+    return reqs
+
+
+class TestFleetBitExact:
+    """Fleet-of-2 vs monolithic at EQUAL aggregate KV budget: 2 pools
+    of 20 allocatable blocks vs one pool of 40."""
+
+    def test_greedy_sampled_and_prefix_hits_match_monolithic(self):
+        from kubeshare_tpu.serving import Request
+
+        config = _small_config(n_kv_heads=2, positional="rope")
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        rng = np.random.default_rng(5)
+        shared = rng.integers(0, 64, 12)
+
+        def requests():
+            out = []
+            for i in range(8):
+                if i % 2 == 0:  # shared-prefix family -> prefix hits
+                    prompt = np.concatenate([shared, rng.integers(0, 64, 4)])
+                else:
+                    prompt = rng.integers(0, 64, 10)
+                key = (jax.random.PRNGKey(70 + i) if i % 3 == 0 else None)
+                out.append(Request(
+                    f"r{i}", prompt, 6,
+                    temperature=(0.8 if key is not None else 0.0),
+                    rng=key))
+            return out
+
+        # the rng sequence must be identical for both arms
+        mono = _mono(params, config, top_k=10, top_p=0.95)
+        mono.warmup()
+        for r in requests():
+            mono.submit(r)
+        mono_out = {k: v.tokens for k, v in mono.run().items()}
+
+        rng = np.random.default_rng(5)
+        shared = rng.integers(0, 64, 12)
+        fleet = _fleet(params, config, top_k=10, top_p=0.95,
+                       shared_tier_bytes=1 << 20)
+        fleet.warmup()
+        baseline = fleet.compile_counts()
+        # interleave arrivals with service so the tries warm up and
+        # affinity actually engages (prefix hits inside each replica)
+        reqs = requests()
+        for r in reqs[:2]:
+            fleet.submit(r)
+        fleet.run()
+        for r in reqs[2:]:
+            fleet.submit(r)
+        fleet_out = {k: v.tokens for k, v in fleet.run().items()}
+
+        assert fleet_out == mono_out
+        # the fleet actually exercised its prefix caches
+        fams = fleet.collect_metrics()
+        assert _metric(fams,
+                       "kubeshare_serving_prefix_hit_tokens_total") > 0
+        # zero recompiles per replica after warmup
+        assert fleet.compile_counts() == baseline
+
+    def test_preemption_inside_a_replica_stays_bit_exact(self):
+        """QoS preemption fires inside one replica (all traffic pinned
+        there) and the streams still match the dense references — the
+        cache-backed resume survives fleet wrapping."""
+        from kubeshare_tpu.models.decoding import greedy_decode
+        from kubeshare_tpu.serving import (QOS_OPPORTUNISTIC, Request,
+                                           RoutingPolicy, TenantRegistry,
+                                           TenantSpec)
+
+        class PinFirst(RoutingPolicy):
+            def route(self, fleet, request, candidates):
+                return candidates[0], "least_loaded"
+
+        config = _small_config(n_kv_heads=2, positional="rope")
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        registry = TenantRegistry([
+            TenantSpec("gold"),
+            TenantSpec("batch", qos_class=QOS_OPPORTUNISTIC),
+        ])
+        fleet = _fleet(params, config, replicas=2, num_blocks=13,
+                       num_slots=2, max_request_len=32,
+                       tenants=registry, routing=PinFirst())
+        fleet.warmup()
+        r0 = fleet.replicas[0]
+        rng = np.random.default_rng(21)
+        p_batch = rng.integers(0, 64, 17)
+        p_gold = rng.integers(0, 64, 18)
+        fleet.submit(Request("victim", p_batch, 14, tenant="batch"))
+        # step until the victim decodes mid-stream (>= 2 emitted)
+        while True:
+            slots = [s for s in r0.engine._slots
+                     if s.rid == "victim" and s.state == "decode"]
+            if slots and len(slots[0].generated) >= 2:
+                break
+            assert fleet.step(), "fleet idle before victim decoded"
+        fleet.submit(Request("gold", p_gold, 6, tenant="gold"))
+        out = fleet.run()
+        assert r0.engine.preemptions.get("batch", 0) >= 1
+        for rid, prompt, new in (("victim", p_batch, 14),
+                                 ("gold", p_gold, 6)):
+            ref = np.asarray(greedy_decode(
+                params, config, jnp.asarray(prompt, jnp.int32)[None],
+                new))[0]
+            assert out[rid].tokens == list(ref), rid
+
+
+class TestRouting:
+    def test_affinity_beats_round_robin_on_shared_prefix_trace(self):
+        """Same trace, same aggregate budget: the affinity arm must
+        recover strictly more prefix tokens than the round-robin
+        control — the router's whole contribution, checked through the
+        metrics plane."""
+        from kubeshare_tpu.serving import RoundRobinPolicy
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+
+        def run_arm(routing):
+            fleet = _fleet(params, config, routing=routing)
+            fleet.warmup()
+            streams = {}
+            for r in _shared_prefix_trace():
+                fleet.submit(r)
+                fleet.run()  # closed-loop: each trie is warm for the next
+            streams = {k: v.tokens for k, v in fleet._results.items()}
+            fams = fleet.collect_metrics()
+            return (streams,
+                    _metric(fams,
+                            "kubeshare_serving_prefix_hit_tokens_total"),
+                    fams)
+
+        rr_streams, rr_hits, _ = run_arm(RoundRobinPolicy())
+        aff_streams, aff_hits, aff_fams = run_arm(None)  # default policy
+        assert aff_streams == rr_streams  # routing never changes streams
+        assert aff_hits > rr_hits
+        # routing reasons through the metrics plane: first request per
+        # group is least_loaded (nothing cached), the rest affinity
+        decisions = "kubeshare_serving_fleet_routing_decisions_total"
+        assert _metric(aff_fams, decisions, reason="affinity") >= 6
+        assert _metric(aff_fams, decisions, reason="least_loaded") >= 3
+
+    def test_guarantee_and_saturation_spills(self):
+        """Two spill paths: Guarantee traffic leaves the affinity
+        target as soon as it would queue at all; Opportunistic traffic
+        sticks with the cache until the target is saturated (no slot
+        AND spill_queue_depth queued)."""
+        from kubeshare_tpu.serving import (QOS_OPPORTUNISTIC,
+                                           PrefixAffinityPolicy, Request,
+                                           TenantRegistry, TenantSpec)
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        registry = TenantRegistry([
+            TenantSpec("gold"),
+            TenantSpec("batch", qos_class=QOS_OPPORTUNISTIC),
+        ])
+        fleet = _fleet(params, config, num_slots=1, tenants=registry,
+                       routing=PrefixAffinityPolicy(spill_queue_depth=1))
+        fleet.warmup()
+        rng = np.random.default_rng(9)
+        shared = rng.integers(0, 64, 12)
+
+        def req(rid, tenant, max_new=4):
+            return Request(rid, np.concatenate(
+                [shared, rng.integers(0, 64, 4)]), max_new, tenant=tenant)
+
+        fleet.submit(req("warm", "batch"))
+        fleet.run()
+        owner = fleet.owner_of("warm")
+        # occupy the owner's only slot (admit via one step, don't run
+        # to completion)
+        fleet.submit(req("fill", "batch", max_new=16))
+        assert fleet.owner_of("fill") == owner
+        fleet.step()
+        # Opportunistic arrival: no free slot but nothing queued yet —
+        # still worth the cached blocks, stays on the owner
+        fleet.submit(req("sticky", "batch", max_new=16))
+        assert fleet.owner_of("sticky") == owner
+        # Guarantee arrival: would queue -> spills to the open replica
+        fleet.submit(req("gold", "gold"))
+        assert fleet.owner_of("gold") != owner
+        # Opportunistic arrival with the owner now saturated (no slot,
+        # one queued) -> saturation spill
+        fleet.submit(req("spilled", "batch"))
+        assert fleet.owner_of("spilled") != owner
+        assert fleet.routing_decisions["spill"] >= 2
+        fleet.run()
+
+
+class TestDrain:
+    def test_drain_hands_trie_to_shared_tier_and_sibling_promotes(self):
+        from kubeshare_tpu.serving import Request
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        fleet = _fleet(params, config, shared_tier_bytes=1 << 20)
+        fleet.warmup()
+        rng = np.random.default_rng(11)
+        shared = rng.integers(0, 64, 16)
+
+        def req(rid):
+            return Request(rid, np.concatenate(
+                [shared, rng.integers(0, 64, 4)]), 4)
+
+        fleet.submit(req("seed"))
+        fleet.run()
+        owner = fleet.owner_of("seed")
+        survivor = [h for h in fleet.replicas if h.name != owner][0]
+        assert survivor.engine.prefix_match_len(shared) == 0
+        fleet.drain(owner)
+        fleet.run()
+        assert fleet._handle(owner).state == "retired"
+        # the retiree's prefix is now host-resident under the survivor
+        assert survivor.engine.prefix_match_len(shared) >= 16
+        assert len(fleet.shared_tier._entries) > 0
+        # ...and a new request on the survivor PROMOTES it (tier hit)
+        fleet.submit(req("heir"))
+        fleet.run()
+        assert fleet.owner_of("heir") == survivor.name
+        assert survivor.engine.tier_hit_requests >= 1
+        fams = fleet.collect_metrics()
+        assert _metric(fams, "kubeshare_serving_fleet_replicas",
+                       state="retired") == 1
+        assert _metric(
+            fams, "kubeshare_serving_fleet_drain_seconds_count") == 1
+
+    def test_drain_below_min_replicas_refuses(self):
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        fleet = _fleet(params, config, replicas=2, min_replicas=2)
+        with pytest.raises(RuntimeError, match="min_replicas"):
+            fleet.drain(fleet.replicas[0].name)
+
+    def test_scale_up_then_zero_recompiles(self):
+        from kubeshare_tpu.serving import Request
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        fleet = _fleet(params, config, replicas=2)
+        fleet.warmup()
+        handle = fleet.scale_up()
+        assert handle.state == "active" and len(fleet.replicas) == 3
+        baseline = fleet.compile_counts()
+        rng = np.random.default_rng(2)
+        for i in range(6):
+            fleet.submit(Request(f"s{i}", rng.integers(0, 64, 10), 5))
+        fleet.run()
+        assert fleet.compile_counts() == baseline
+        assert fleet.scale_events["up"] == 1
+
+
+class TestAutoscaler:
+    def _stub_fleet(self):
+        from kubeshare_tpu.serving.engine import TTFT_BUCKETS
+        from kubeshare_tpu.serving.fleet import _bucket_observe
+
+        class Stub:
+            def __init__(self):
+                self.counts = [0] * (len(TTFT_BUCKETS) + 1)
+                self.idle = True
+
+            def observe(self, seconds, n=1):
+                _bucket_observe(self.counts, seconds, TTFT_BUCKETS, n)
+
+            def _ttft_counts_snapshot(self):
+                return list(self.counts)
+
+        return Stub()
+
+    def test_sustained_breach_scales_up_once(self):
+        from kubeshare_tpu.serving import TTFTBreachPolicy
+
+        fleet = self._stub_fleet()
+        policy = TTFTBreachPolicy(0.1, breach_cycles=3, min_samples=2)
+        assert policy.decide(fleet) is None  # baseline snapshot
+        for i in range(2):
+            fleet.observe(1.0, 4)
+            assert policy.decide(fleet) is None, i
+        fleet.observe(1.0, 4)
+        assert policy.decide(fleet) == "up"
+        # the streak reset: the next breach interval starts over
+        fleet.observe(1.0, 4)
+        assert policy.decide(fleet) is None
+
+    def test_bursty_trace_never_flaps(self):
+        """Alternating breach/healthy intervals (the bursty trace) must
+        never reach breach_cycles — no flapping."""
+        from kubeshare_tpu.serving import TTFTBreachPolicy
+
+        fleet = self._stub_fleet()
+        policy = TTFTBreachPolicy(0.1, breach_cycles=2, idle_cycles=3,
+                                  min_samples=2)
+        policy.decide(fleet)
+        for _ in range(6):
+            fleet.observe(1.0, 4)     # breach interval
+            assert policy.decide(fleet) is None
+            fleet.observe(0.01, 4)    # healthy interval resets
+            assert policy.decide(fleet) is None
+
+    def test_sustained_idle_drains(self):
+        from kubeshare_tpu.serving import TTFTBreachPolicy
+
+        fleet = self._stub_fleet()
+        policy = TTFTBreachPolicy(0.1, idle_cycles=3, min_samples=2)
+        assert policy.decide(fleet) is None
+        assert policy.decide(fleet) is None
+        assert policy.decide(fleet) == "down"
+        # thin-but-nonzero interval is neither idle nor breach: resets
+        fleet.observe(0.01, 1)
+        assert policy.decide(fleet) is None
+        assert policy.decide(fleet) is None
+        assert policy.decide(fleet) is None
+        assert policy.decide(fleet) == "down"
+
+    def test_fleet_applies_policy_decisions(self):
+        """Wire a scripted policy through the fleet's autoscale tick:
+        one up, one down — the fleet grows, then drains its
+        least-loaded replica and retires it."""
+        from kubeshare_tpu.serving import Request, ScalingPolicy
+
+        class Script(ScalingPolicy):
+            def __init__(self):
+                self.plan = ["up", None, "down"]
+
+            def decide(self, fleet):
+                return self.plan.pop(0) if self.plan else None
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        fleet = _fleet(params, config, replicas=2, max_replicas=3,
+                       scaling=Script(), autoscale_every=1)
+        fleet.warmup()
+        rng = np.random.default_rng(4)
+        for i in range(6):
+            fleet.submit(Request(f"a{i}", rng.integers(0, 64, 10), 4))
+        fleet.run()
+        states = sorted(h.state for h in fleet.replicas)
+        assert len(fleet.replicas) == 3
+        assert states.count("retired") == 1
+        assert fleet.scale_events == {"up": 1, "down": 1}
+
+
+class TestCarving:
+    def test_carve_replica_groups_slices(self):
+        from kubeshare_tpu.parallel.mesh import MeshSpec
+        from kubeshare_tpu.serving import carve_replica_groups
+
+        devs = list("abcdefgh")
+        assert carve_replica_groups(
+            MeshSpec(dp=2, tp=2, sp=1), devs) == [["a", "b"], ["c", "d"]]
+        assert carve_replica_groups(
+            MeshSpec(dp=-1, tp=3, sp=1), devs) == [
+                ["a", "b", "c"], ["d", "e", "f"]]
+
+    def test_carve_validation_errors(self):
+        from kubeshare_tpu.parallel.mesh import MeshSpec
+        from kubeshare_tpu.serving import carve_replica_groups
+
+        devs = list("abcd")
+        with pytest.raises(ValueError, match="ep=sp=1"):
+            carve_replica_groups(MeshSpec(dp=2, tp=1, ep=2, sp=1), devs)
+        with pytest.raises(ValueError, match="explicit tp"):
+            carve_replica_groups(MeshSpec(dp=2, tp=-1, sp=1), devs)
+        with pytest.raises(ValueError, match="dp must be"):
+            carve_replica_groups(MeshSpec(dp=0, tp=1, sp=1), devs)
+        with pytest.raises(ValueError, match="only 4 available"):
+            carve_replica_groups(MeshSpec(dp=3, tp=2, sp=1), devs)
+        with pytest.raises(ValueError, match="does not fit"):
+            carve_replica_groups(MeshSpec(dp=-1, tp=8, sp=1), devs)
+
+    def test_single_engine_dp_rejection_points_at_fleet(self):
+        from kubeshare_tpu.parallel.mesh import MeshSpec
+        from kubeshare_tpu.serving import EngineConfig, ServingEngine
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        with pytest.raises(ValueError, match="ReplicaFleet"):
+            ServingEngine(params, config, EngineConfig(
+                num_slots=2, block_size=4, num_blocks=13,
+                max_request_len=32, prefill_chunk=8,
+                mesh_spec=MeshSpec(dp=2, tp=1, sp=1)))
+
+    def test_mesh_devices_requires_mesh_spec(self):
+        from kubeshare_tpu.serving import EngineConfig, ServingEngine
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        with pytest.raises(ValueError, match="mesh_devices requires"):
+            ServingEngine(params, config, EngineConfig(
+                num_slots=2, block_size=4, num_blocks=13,
+                max_request_len=32, prefill_chunk=8),
+                mesh_devices=jax.devices()[:1])
+
+    def test_fleet_refuses_more_replicas_than_groups(self):
+        from kubeshare_tpu.parallel.mesh import MeshSpec
+        from kubeshare_tpu.serving import EngineConfig, ReplicaFleet
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        n = len(jax.devices())
+        ec = EngineConfig(num_slots=2, block_size=4, num_blocks=13,
+                          max_request_len=32, prefill_chunk=8,
+                          mesh_spec=MeshSpec(dp=n, tp=1, sp=1))
+        with pytest.raises(ValueError, match="device group"):
+            ReplicaFleet(params, config, ec, replicas=n + 1)
+
+
+class TestFleetMetrics:
+    def test_replica_label_and_no_shared_tier_double_count(self):
+        from kubeshare_tpu.serving import Request
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        fleet = _fleet(params, config, shared_tier_bytes=1 << 20)
+        fleet.warmup()
+        rng = np.random.default_rng(8)
+        shared = rng.integers(0, 64, 16)
+        fleet.submit(Request("a", np.concatenate(
+            [shared, rng.integers(0, 64, 4)]), 4))
+        fleet.run()
+        fleet.drain(fleet.owner_of("a"))
+        fleet.run()
+        fams = fleet.collect_metrics()
+        # dispatch series carry the replica label, one series each
+        names = {(s.labels.get("replica"), s.labels.get("kind"))
+                 for f in fams if f.name ==
+                 "kubeshare_serving_dispatches_total"
+                 for s in f.samples}
+        replicas = {r for r, _ in names}
+        assert replicas == {"r0", "r1"}
+        # the shared tier's byte gauges appear once, at the TIER's
+        # value (not replicas x used)
+        used = [s.value for f in fams
+                if f.name == "kubeshare_serving_tier_host_bytes"
+                for s in f.samples if s.labels.get("kind") == "used"]
+        assert used == [fleet.shared_tier.used_bytes]
+        # host_evicted likewise reported once from the shared store
+        evicted = [s.value for f in fams
+                   if f.name == "kubeshare_serving_tier_blocks_total"
+                   for s in f.samples
+                   if s.labels.get("event") == "host_evicted"]
+        assert evicted == [fleet.shared_tier.evicted_blocks]
+
+
+class TestPlacementAdapter:
+    TOPOLOGY = """
+cellTypes:
+  V4-NODE:
+    childCellType: "TPU-v4"
+    childCellNumber: 4
+    childCellPriority: 60
+    isNodeLevel: true
+  2-V4-NODE:
+    childCellType: V4-NODE
+    childCellNumber: 2
+cells:
+- cellType: 2-V4-NODE
+  cellChildren:
+  - cellId: host-a
+  - cellId: host-b
+"""
+
+    def _plane(self, **kwargs):
+        from kubeshare_tpu import constants
+        from kubeshare_tpu.cell import load_config
+        from kubeshare_tpu.cell.allocator import ChipInfo
+        from kubeshare_tpu.cluster.api import FakeClock, Node
+        from kubeshare_tpu.cluster.fake import FakeCluster
+        from kubeshare_tpu.scheduler import (FleetPlacementPlane,
+                                             KubeShareScheduler,
+                                             SchedulerArgs,
+                                             SchedulerEngine)
+
+        hbm = 32 << 30
+        inventory = {
+            node: [ChipInfo(f"{node}-tpu-{i}", hbm, "TPU-v4", i,
+                            (i, rank, 0)) for i in range(4)]
+            for rank, node in enumerate(("host-a", "host-b"))
+        }
+        cluster = FakeCluster()
+        for n in ("host-a", "host-b"):
+            cluster.add_node(Node(
+                name=n, labels={constants.NODE_LABEL_FILTER: "true"}))
+        clock = FakeClock(1000.0)
+        plugin = KubeShareScheduler(
+            topology=load_config(text=self.TOPOLOGY), cluster=cluster,
+            inventory=lambda node: inventory.get(node, []),
+            args=SchedulerArgs(), clock=clock)
+        engine = SchedulerEngine(plugin, cluster, clock)
+        return FleetPlacementPlane(engine, cluster, **kwargs), cluster
+
+    def test_place_binds_fractional_cell_and_release_reclaims(self):
+        plane, cluster = self._plane(gpu_request="0.5", gpu_limit="0.5",
+                                     gpu_memory=1 << 30, priority=10)
+        p0 = plane.place("r0")
+        p1 = plane.place("r1")
+        assert p0.cell_id and p0.gpu_uuid and p0.node
+        assert {p0.node, p1.node} <= {"host-a", "host-b"}
+        # release then re-place: the freed cell is schedulable again
+        plane.release("r0")
+        p2 = plane.place("r2")
+        assert p2.cell_id
+        plane.release("unknown")  # idempotent no-op
+
+    def test_unplaceable_replica_is_loud(self):
+        # ask for more chips than any node holds
+        plane, _ = self._plane(gpu_request="8.0", gpu_limit="8.0")
+        with pytest.raises(RuntimeError, match="unplaceable"):
+            plane.place("r0")
+
+    def test_fleet_places_and_releases_through_the_plane(self):
+        """End to end: the fleet calls place() per replica at build and
+        release() at retirement."""
+        from kubeshare_tpu.serving import Request
+
+        plane, cluster = self._plane(gpu_request="0.5", gpu_limit="0.5",
+                                     gpu_memory=1 << 30, priority=10)
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        fleet = _fleet(params, config, placement=plane)
+        assert all(h.placement is not None for h in fleet.replicas)
+        assert len(cluster.list_pods(namespace="serving")) == 2
+        fleet.warmup()
+        fleet.submit(Request("a", np.arange(8), 3))
+        fleet.run()
+        victim = fleet.replicas[0].name
+        fleet.drain(victim)
+        fleet.run()
+        # the retired replica's pod is gone; the survivor's remains
+        assert len(cluster.list_pods(namespace="serving")) == 1
