@@ -65,9 +65,28 @@ The serving subsystem the fractional-chip runtime was built to host:
   (:class:`TTFTBreachPolicy` with hysteresis), and draining retirees
   through the shared host tier so survivors inherit their caches —
   streams bit-exact with one monolithic engine at equal aggregate KV
-  budget.
+  budget;
+- :mod:`metrics_view` — shared PromQL-style readers over the metrics
+  plane: per-consumer interval windows over cumulative counters and
+  histogram buckets (``increase()``), quantile estimation
+  (``histogram_quantile()``), and snapshot flattening — the one
+  implementation the autoscaler, the autotuner, and the benches all
+  diff through;
+- :mod:`autotune` — the cost-model-driven online autotuner: a
+  per-dispatch-kind cost model fitted from the engine's own interval
+  counters, a pluggable sandboxed :class:`TuningPolicy` interface
+  (:class:`AnalyticPolicy` default, :class:`FittedTracePolicy` from a
+  recorded trace), and an :class:`AutoTuner` retuning the
+  RECOMPILE-FREE knob subset — fused-prefill budget, effective loop
+  depth, draft-width cap, disagg pacing/reserve, fleet TTFT threshold
+  — strictly inside the warmed-shape/validated-range envelope, so a
+  bad policy can cost throughput but never a recompile or an invalid
+  config.
 """
 
+from .autotune import (AnalyticPolicy, AutoTuner, CostModel,
+                       FittedTracePolicy, Knob, KnobSpec, KnobView,
+                       TuningPolicy)
 from .disagg import (DecodePool, DisaggRouter, DisaggTopology, KVMigrator,
                      PrefillPool)
 from .drafter import NGramDrafter
@@ -78,6 +97,9 @@ from .fleet import (PrefixAffinityPolicy, ReplicaFleet, ReplicaHandle,
                     TTFTBreachPolicy)
 from .kv_blocks import (BlockAllocator, BlockExhausted, PagedKVPool,
                         QuotaExceeded, chain_token_runs, init_paged_pool)
+from .metrics_view import (CounterWindow, HistogramWindow, flatten_metrics,
+                           hist_quantile, interval_quantile,
+                           metric_histogram, metric_value)
 from .kv_tier import (KV_CHAIN_VERSION, KV_WIRE_VERSION, HostTier,
                       LRUTierPolicy, QoSTierPolicy, TierPolicy, pack_block,
                       pack_chain, unpack_block, unpack_chain,
@@ -94,18 +116,27 @@ from .sharded import (ShardDecision, ShardedServingContext,
                       serving_sharding_rules)
 
 __all__ = [
+    "AnalyticPolicy",
+    "AutoTuner",
     "BlockAllocator",
     "BlockExhausted",
+    "CostModel",
+    "CounterWindow",
     "DEFAULT_TENANT",
     "DecodePool",
     "DisaggRouter",
     "DisaggTopology",
     "EngineConfig",
     "FairQueue",
+    "FittedTracePolicy",
+    "HistogramWindow",
     "HostTier",
     "KVMigrator",
     "KV_CHAIN_VERSION",
     "KV_WIRE_VERSION",
+    "Knob",
+    "KnobSpec",
+    "KnobView",
     "LRUTierPolicy",
     "NGramDrafter",
     "PagedKVPool",
@@ -130,9 +161,15 @@ __all__ = [
     "TTFTBreachPolicy",
     "TenantRegistry",
     "TenantSpec",
+    "TuningPolicy",
     "carve_replica_groups",
     "chain_token_runs",
+    "flatten_metrics",
+    "hist_quantile",
     "init_paged_pool",
+    "interval_quantile",
+    "metric_histogram",
+    "metric_value",
     "pack_block",
     "pack_chain",
     "paged_copy_block",

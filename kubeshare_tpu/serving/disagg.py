@@ -75,6 +75,7 @@ import numpy as np
 from ..parallel.distributed import (MultisliceSpec, multislice_spec_from_env,
                                     slice_device_mesh)
 from ..utils.promtext import MetricFamily, Sample
+from .autotune import AutoTuner
 from .engine import (EngineConfig, Request, RequestResult, ServingEngine,
                      _histogram_samples, _bucket_observe,
                      plan_prefill_chunks)
@@ -508,6 +509,10 @@ class DisaggRouter:
                 f"decode_priority must be >= 1, got {decode_priority}")
         self._decode_priority = decode_priority
         self._decode_streak = 0
+        # held as an attribute (not closed over) so the autotuner can
+        # retune the reserve margin between steps; the admission gate
+        # reads the live value on every call
+        self._max_pending_handoffs = max_pending_handoffs
         if max_pending_handoffs is not None:
             # handoff backpressure: a stream's first token is emitted at
             # prefill completion, so every finished-but-undelivered
@@ -526,8 +531,19 @@ class DisaggRouter:
                 free_d = sum(s.state == "free"
                              for s in self.decode._slots)
                 return (staged + len(self._tickets)
-                        < min(max_pending_handoffs, free_d))
+                        < min(self._max_pending_handoffs, free_d))
             self.prefill.admission_gate = gate
+        # router-level autotuner (serving/autotune.py): retunes the
+        # pacing ratio and reserve margin within their validated
+        # ranges.  Knobs exist only for limits the router was built
+        # with; tick time is charged to the decode pool's
+        # host_seconds["tune"], never to either pool's planner.
+        self._tuner = (AutoTuner.for_router(
+            self, interval=decode_config.autotune_interval)
+            if ((prefill_config.autotune or decode_config.autotune)
+                and (decode_priority is not None
+                     or max_pending_handoffs is not None))
+            else None)
 
     # ------------------------------------------------------------------
     def submit(self, request: Request) -> RequestResult:
@@ -562,6 +578,13 @@ class DisaggRouter:
         prefill pool (handoffs append tickets), deliver fresh tickets,
         advance the decode pool.  Returns False only when everything —
         both pools and the ticket list — is drained."""
+        if self._tuner is not None:
+            # tick before either pool advances: the tuner reads last
+            # iteration's fully-consumed counters and retunes the
+            # pacing/reserve knobs the gates below consult
+            t0 = time.monotonic()
+            self._tuner.tick()
+            self.decode.host_seconds["tune"] += time.monotonic() - t0
         worked = self._drain_tickets()
         if self._stage_pool is not None:
             # serialize a few already-final prompt blocks ahead of
@@ -696,6 +719,21 @@ class DisaggRouter:
                 if s.labels.get("event") != "host_evicted"]
             fam.add({"event": "host_evicted"},
                     self.shared_tier.evicted_blocks)
+        if self._tuner is not None:
+            # the router's own tuner decisions join the merged family;
+            # pool="router" keeps them distinct from any per-pool
+            # engine tuner's samples
+            fam = merged.get("kubeshare_serving_tuner_decisions_total")
+            if fam is None:
+                fam = MetricFamily(
+                    "kubeshare_serving_tuner_decisions_total",
+                    "Autotuner knob decisions by knob and direction.",
+                    "counter")
+                merged[fam.name] = fam
+            for (knob, direction), n in sorted(
+                    self._tuner.decisions.items()):
+                fam.add({"knob": knob, "direction": direction,
+                         "pool": "router"}, n)
         return list(merged.values()) + self.migrator.collect_metrics()
 
     @staticmethod

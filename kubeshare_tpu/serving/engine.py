@@ -108,6 +108,7 @@ from ..models.transformer import TransformerConfig
 from ..parallel.mesh import MeshSpec
 from ..utils.promtext import (MetricFamily, MetricServer, Sample,
                               _format_value)
+from .autotune import AnalyticPolicy, AutoTuner
 from .drafter import NGramDrafter
 from .kv_blocks import (BlockAllocator, BlockExhausted, QuotaExceeded,
                         init_paged_pool)
@@ -325,6 +326,126 @@ class EngineConfig:
     # query time beats splitting heads).  None = always head-parallel.
     # Requires mesh_spec; bit-exact either way (test-locked).
     long_context_threshold: Optional[int] = None
+    # ONLINE AUTOTUNING (serving/autotune.py): retune the RECOMPILE-
+    # FREE knob subset every autotune_interval scheduler steps — the
+    # fused-prefill budget (within the warmed chunk universe, which is
+    # warmed in FULL under autotune so the budget can move both ways),
+    # the effective device-loop depth (among warmed loop-K shapes; the
+    # configured steps_per_launch is the ceiling), and the per-lane
+    # draft-width cap (cost-model expected tokens-per-dispatch in
+    # place of the fixed EMA doubling rule).  Every knob is
+    # scheduling-only: streams are bit-exact tuner-on vs tuner-off and
+    # compile counts stay fixed after warmup (test-locked); a plugged
+    # TuningPolicy is sandboxed to the warmed-shape envelope.
+    autotune: bool = False
+    autotune_interval: int = 32
+
+
+def _warmed_prefill_widths(ec: EngineConfig) -> set:
+    """The prefill-chunk bucket universe warmup compiles (and the
+    autotuner's fused-budget envelope): the configured chunk plus every
+    smaller power of two, capped at the slot row bound so a short pool
+    folds over-wide buckets into one max_request_len-wide shape.  Empty
+    on a decode-role pool — no prefill shape ever dispatches there."""
+    widths = {ec.prefill_chunk}
+    w = 1
+    while w < ec.prefill_chunk:
+        widths.add(w)
+        w *= 2
+    widths = {min(w, ec.max_request_len) for w in widths}
+    return set() if ec.pool_role == "decode" else widths
+
+
+def _config_rows(ec: EngineConfig, config: TransformerConfig,
+                 mesh_devices=None, shared_host_tier=None):
+    """The engine-config validation table: ``(failed, message)`` rows
+    checked in order by :class:`ServingEngine`, consolidating what used
+    to be a scatter of inline raises — every interacting-knob
+    constraint (and its loud message) is visible and extendable in ONE
+    place, and a new knob adds a row instead of another branch."""
+    widths = _warmed_prefill_widths(ec)
+    min_piece = min(widths) if widths else 1
+    wire = (wire_block_bytes(
+        ec.block_size, config.n_layers, config.kv_heads,
+        ec.block_size, config.head_dim,
+        jnp.dtype(config.dtype).itemsize)
+        if ec.host_tier_bytes is not None else None)
+    return [
+        (mesh_devices is not None and ec.mesh_spec is None,
+         "mesh_devices requires mesh_spec — an unsharded engine "
+         "has no mesh to pin onto a device group; pin it with "
+         "jax.default_device + device_put instead (the fleet's "
+         "tp=1 build path does exactly that)"),
+        (ec.max_request_len > config.max_seq_len,
+         f"max_request_len {ec.max_request_len} exceeds the model's "
+         f"max_seq_len {config.max_seq_len}"),
+        (ec.prefill_chunk < 1,
+         f"prefill_chunk must be >= 1, got {ec.prefill_chunk}"),
+        (ec.decode_span < 1,
+         f"decode_span must be >= 1, got {ec.decode_span}"),
+        (ec.steps_per_launch < 1
+         or bool(ec.steps_per_launch & (ec.steps_per_launch - 1)),
+         f"steps_per_launch must be a power of two >= 1, got "
+         f"{ec.steps_per_launch} — the loop warms exactly one "
+         f"shape per config, and power-of-two K keeps the knob "
+         f"space aligned with the other fused widths"),
+        (ec.steps_per_launch > 1 and ec.pool_role == "prefill",
+         f"steps_per_launch {ec.steps_per_launch} is meaningless "
+         f"on a prefill-role pool — it never runs decode plans, "
+         f"so the device loop would silently never fire; set "
+         f"steps_per_launch=1"),
+        (ec.mixed_prefill_budget is not None
+         and ec.mixed_prefill_budget < 1,
+         f"mixed_prefill_budget must be >= 1 or None, got "
+         f"{ec.mixed_prefill_budget}"),
+        (ec.mixed and ec.mixed_prefill_budget is not None
+         and ec.mixed_prefill_budget < min_piece,
+         f"mixed_prefill_budget {ec.mixed_prefill_budget} is below "
+         f"the smallest warmed chunk piece ({min_piece}) — no fused "
+         f"chunk could ever be sliced to fit, so prefill would "
+         f"silently starve behind decode"),
+        (ec.host_tier_bytes is not None and not ec.prefix_cache,
+         "host_tier_bytes requires prefix_cache=True — the tier "
+         "spills the radix index; there is nothing to spill "
+         "without it"),
+        (ec.host_tier_bytes is not None and wire is not None
+         and ec.host_tier_bytes < wire,
+         f"host_tier_bytes {ec.host_tier_bytes} is below one "
+         f"block's wire size ({wire}) — the tier could "
+         f"never hold a single block"),
+        (ec.tier_policy not in ("lru", "qos"),
+         f"tier_policy must be 'lru' or 'qos', got "
+         f"{ec.tier_policy!r}"),
+        (ec.draft_len < 1 or bool(ec.draft_len & (ec.draft_len - 1)),
+         f"draft_len must be a power of two >= 1, got "
+         f"{ec.draft_len} — the adaptive width doubles/halves "
+         f"within the warmed power-of-two verify shape set"),
+        (ec.draft_ngram < 1,
+         f"draft_ngram must be >= 1, got {ec.draft_ngram}"),
+        (ec.pool_role not in ("both", "prefill", "decode"),
+         f"pool_role must be 'both', 'prefill' or 'decode', got "
+         f"{ec.pool_role!r}"),
+        (ec.pool_role != "both" and ec.mixed,
+         f"pool_role {ec.pool_role!r} excludes mixed batching — "
+         f"a single-phase pool has no prefill+decode coexistence "
+         f"to fuse; set mixed=False"),
+        (shared_host_tier is not None and ec.host_tier_bytes is not None,
+         "shared_host_tier and host_tier_bytes are mutually "
+         "exclusive — the disagg router owns the shared tier's "
+         "budget"),
+        (shared_host_tier is not None and not ec.prefix_cache,
+         "shared_host_tier requires prefix_cache=True — the tier "
+         "spills the radix index; there is nothing to spill "
+         "without it"),
+        (ec.long_context_threshold is not None and ec.mesh_spec is None,
+         "long_context_threshold requires mesh_spec — the "
+         "Ulysses route is a re-shard inside the sharded "
+         "program; a single-device engine has nothing to route"),
+        (ec.autotune_interval < 1,
+         f"autotune_interval must be >= 1, got "
+         f"{ec.autotune_interval} — the tuner ticks once per "
+         f"scheduler step and retunes every interval-th tick"),
+    ]
 
 
 @dataclass
@@ -503,82 +624,18 @@ class ServingEngine:
         tier_ledger_hook=None,
         replica_label: Optional[str] = None,
         mesh_devices=None,
+        tuning_policy=None,
     ) -> None:
         ec = engine_config or EngineConfig()
-        if mesh_devices is not None and ec.mesh_spec is None:
-            raise ValueError(
-                "mesh_devices requires mesh_spec — an unsharded engine "
-                "has no mesh to pin onto a device group; pin it with "
-                "jax.default_device + device_put instead (the fleet's "
-                "tp=1 build path does exactly that)")
-        if ec.max_request_len > config.max_seq_len:
-            raise ValueError(
-                f"max_request_len {ec.max_request_len} exceeds the model's "
-                f"max_seq_len {config.max_seq_len}"
-            )
-        if ec.prefill_chunk < 1:
-            raise ValueError(f"prefill_chunk must be >= 1, got {ec.prefill_chunk}")
-        if ec.decode_span < 1:
-            raise ValueError(f"decode_span must be >= 1, got {ec.decode_span}")
-        if ec.steps_per_launch < 1 or (
-                ec.steps_per_launch & (ec.steps_per_launch - 1)):
-            raise ValueError(
-                f"steps_per_launch must be a power of two >= 1, got "
-                f"{ec.steps_per_launch} — the loop warms exactly one "
-                f"shape per config, and power-of-two K keeps the knob "
-                f"space aligned with the other fused widths")
-        if ec.steps_per_launch > 1 and ec.pool_role == "prefill":
-            raise ValueError(
-                f"steps_per_launch {ec.steps_per_launch} is meaningless "
-                f"on a prefill-role pool — it never runs decode plans, "
-                f"so the device loop would silently never fire; set "
-                f"steps_per_launch=1")
-        if ec.mixed_prefill_budget is not None and ec.mixed_prefill_budget < 1:
-            raise ValueError(
-                f"mixed_prefill_budget must be >= 1 or None, got "
-                f"{ec.mixed_prefill_budget}")
-        if ec.host_tier_bytes is not None and not ec.prefix_cache:
-            raise ValueError(
-                "host_tier_bytes requires prefix_cache=True — the tier "
-                "spills the radix index; there is nothing to spill "
-                "without it")
-        if ec.tier_policy not in ("lru", "qos"):
-            raise ValueError(
-                f"tier_policy must be 'lru' or 'qos', got "
-                f"{ec.tier_policy!r}")
-        if ec.draft_len < 1 or (ec.draft_len & (ec.draft_len - 1)):
-            raise ValueError(
-                f"draft_len must be a power of two >= 1, got "
-                f"{ec.draft_len} — the adaptive width doubles/halves "
-                f"within the warmed power-of-two verify shape set")
-        if ec.draft_ngram < 1:
-            raise ValueError(
-                f"draft_ngram must be >= 1, got {ec.draft_ngram}")
-        if ec.pool_role not in ("both", "prefill", "decode"):
-            raise ValueError(
-                f"pool_role must be 'both', 'prefill' or 'decode', got "
-                f"{ec.pool_role!r}")
-        if ec.pool_role != "both" and ec.mixed:
-            raise ValueError(
-                f"pool_role {ec.pool_role!r} excludes mixed batching — "
-                f"a single-phase pool has no prefill+decode coexistence "
-                f"to fuse; set mixed=False")
-        if shared_host_tier is not None and ec.host_tier_bytes is not None:
-            raise ValueError(
-                "shared_host_tier and host_tier_bytes are mutually "
-                "exclusive — the disagg router owns the shared tier's "
-                "budget")
-        if shared_host_tier is not None and not ec.prefix_cache:
-            raise ValueError(
-                "shared_host_tier requires prefix_cache=True — the tier "
-                "spills the radix index; there is nothing to spill "
-                "without it")
-        if (ec.long_context_threshold is not None
-                and ec.mesh_spec is None):
-            raise ValueError(
-                "long_context_threshold requires mesh_spec — the "
-                "Ulysses route is a re-shard inside the sharded "
-                "program; a single-device engine has nothing to route")
+        # the table-driven validation pass: every interacting-knob
+        # constraint lives in _config_rows (one (failed, message) row
+        # each), checked in order so the first violation raises with
+        # its original loud message
+        for failed, message in _config_rows(
+                ec, config, mesh_devices=mesh_devices,
+                shared_host_tier=shared_host_tier):
+            if failed:
+                raise ValueError(message)
         # fail fast on a bad filter set, like the dense sampling entries
         _filter_logits(jnp.zeros((1, 2)), ec.top_k, ec.top_p)
         # tensor-parallel mode: the context owns the mesh, the sharding
@@ -608,15 +665,8 @@ class ServingEngine:
         self.tenants = tenants or TenantRegistry.default()
         self.host_tier: Optional[HostTier] = None
         if ec.host_tier_bytes is not None:
-            full_wire = wire_block_bytes(
-                ec.block_size, config.n_layers, config.kv_heads,
-                ec.block_size, config.head_dim,
-                jnp.dtype(config.dtype).itemsize)
-            if ec.host_tier_bytes < full_wire:
-                raise ValueError(
-                    f"host_tier_bytes {ec.host_tier_bytes} is below one "
-                    f"block's wire size ({full_wire}) — the tier could "
-                    f"never hold a single block")
+            # the below-one-block's-wire-size check moved into the
+            # _config_rows validation table with the rest
             policy = (LRUTierPolicy() if ec.tier_policy == "lru"
                       else QoSTierPolicy(self.tenants))
             self.host_tier = HostTier(ec.host_tier_bytes, policy,
@@ -651,6 +701,17 @@ class ServingEngine:
                               else ec.prefill_chunk)
         self._prefill_rr = 0
         self._inflight = None
+        # the warmed prefill-chunk bucket universe — warmup compiles
+        # exactly this set, and the autotuner's fused-budget envelope
+        # is confined to it (a tuned budget can only select among
+        # already-compiled shapes)
+        self._warmed_widths = _warmed_prefill_widths(ec)
+        # autotuner-owned scheduling state: the effective device-loop
+        # depth (starts at the configured ceiling; the tuner moves it
+        # among warmed loop-K shapes) and the per-lane draft-width cap
+        # (starts uncapped at draft_len)
+        self._loop_k = ec.steps_per_launch
+        self._draft_width_cap = ec.draft_len
         # admission queue: the QoS fair queue over _Pending entries
         # (plan + block count computed once at submit; _admit re-plans
         # only on a prefix-cache hit).  The default registry holds one
@@ -706,7 +767,8 @@ class ServingEngine:
         # of planner invocations — the numerator and denominator the
         # --device-loop bench divides by emitted tokens
         self.host_seconds: Dict[str, float] = {
-            "admit": 0.0, "plan": 0.0, "dispatch": 0.0, "consume": 0.0}
+            "admit": 0.0, "plan": 0.0, "dispatch": 0.0, "consume": 0.0,
+            "tune": 0.0}
         self.host_planner_invocations = 0
         # speculation counters, per tenant: proposals scored by verify
         # dispatches, drafts actually emitted, and the per-round
@@ -822,23 +884,35 @@ class ServingEngine:
             decode = sharded.decode_span(pick_rows, span, eos)
         self._decode_step = jax.jit(decode, donate_argnums=(1, 2))
 
-        k_units = ec.steps_per_launch
-
-        def loop(w, pk, pv, tables, lengths, active, tokens, temps,
-                 keys, budgets):
+        def make_loop(k_units):
             # the device-resident multi-step loop: up to K span-units
             # (each the exact decode scan above) in ONE launch, with
             # on-device ring buffering and a lanes-changed early exit
             # — the host planner runs once per launch instead of once
-            # per span.  Built only when steps_per_launch > 1.
-            return paged_decode_loop(
-                w, cfg, pick_rows, span, k_units, eos, pk, pv, tables,
-                lengths, active, tokens, temps, keys, budgets)
+            # per span.  K is a static arg of the fused program, so
+            # each depth is its own warmed shape.
+            def loop(w, pk, pv, tables, lengths, active, tokens, temps,
+                     keys, budgets):
+                return paged_decode_loop(
+                    w, cfg, pick_rows, span, k_units, eos, pk, pv,
+                    tables, lengths, active, tokens, temps, keys,
+                    budgets)
 
-        if sharded is not None and k_units > 1:
-            loop = sharded.decode_loop(pick_rows, span, k_units, eos)
-        self._loop_step = (jax.jit(loop, donate_argnums=(1, 2))
-                           if k_units > 1 else None)
+            if sharded is not None:
+                loop = sharded.decode_loop(pick_rows, span, k_units, eos)
+            return jax.jit(loop, donate_argnums=(1, 2))
+
+        # one jitted loop program per depth: just the configured K
+        # normally; under autotune, EVERY power-of-two depth up to the
+        # configured ceiling, so the tuner's effective-K knob only ever
+        # selects among warmed shapes (K=1 is the plain decode step —
+        # the loop disarmed — and needs no program here)
+        loop_ks = []
+        if ec.steps_per_launch > 1:
+            loop_ks = ([k for k in (2 ** i for i in range(1, 32))
+                        if k <= ec.steps_per_launch] if ec.autotune
+                       else [ec.steps_per_launch])
+        self._loop_steps = {k: make_loop(k) for k in loop_ks}
 
         def mixed(w, pk, pv, p_table, p_start, p_tokens, p_last_row,
                   p_temp, p_key, d_tables, d_lengths, d_active,
@@ -914,6 +988,16 @@ class ServingEngine:
             # unpack are sharding-agnostic host-side
             upload = sharded.upload_block
         self._upload_step = jax.jit(upload, donate_argnums=(0, 1))
+
+        # the online autotuner (serving/autotune.py): ticked by step()
+        # between consume and plan, so _plan_step always reads
+        # freshly-retuned knobs.  The policy is pluggable and
+        # sandboxed — only values inside the warmed-shape envelope
+        # above ever apply.
+        self._tuner = (AutoTuner.for_engine(
+            self, policy=tuning_policy or AnalyticPolicy(),
+            interval=ec.autotune_interval)
+            if ec.autotune else None)
 
     # ------------------------------------------------------------------
     # public API
@@ -1080,7 +1164,7 @@ class ServingEngine:
             if hint:
                 slot.drafter.hint(hint)
             slot.drafter.extend([int(first_token)])
-            slot.draft_width = ec.draft_len
+            slot.draft_width = min(ec.draft_len, self._draft_width_cap)
             slot.accept_rate = 0.5
         self.peak_blocks_in_use = max(
             self.peak_blocks_in_use, self.allocator.blocks_in_use)
@@ -1110,11 +1194,22 @@ class ServingEngine:
         t1 = time.monotonic()
         consumed = self._consume_inflight()
         t2 = time.monotonic()
+        # the tuner ticks BETWEEN consume and plan: it reads the
+        # fully-consumed counters and retunes its knobs before
+        # _plan_step consults them — and its wall time lands in the
+        # "tune" phase, never in "plan" (tuner overhead is first-class
+        # observable, and the planner/host counters exclude it)
+        if self._tuner is not None:
+            self._tuner.tick()
+            t2t = time.monotonic()
+        else:
+            t2t = t2  # no tuner: the "tune" phase stays exactly zero
         plan = self._plan_step()
         t3 = time.monotonic()
         hs["admit"] += t1 - t0
         hs["consume"] += t2 - t1
-        hs["plan"] += t3 - t2
+        hs["tune"] += t2t - t2
+        hs["plan"] += t3 - t2t
         if plan is None:
             return consumed
         self._dispatch_plan(plan)
@@ -1197,7 +1292,7 @@ class ServingEngine:
                     max(len(d) for d in drafts.values()))
                 return _StepPlan("verify", decode_slots=decode,
                                  drafts=drafts, verify_width=width)
-        if self._loop_step is not None and not fused:
+        if self._loop_k > 1 and not fused:
             return _StepPlan("loop", decode_slots=decode)
         return _StepPlan("decode", decode_slots=decode)
 
@@ -1311,17 +1406,11 @@ class ServingEngine:
         shape runs with ZERO recompilation (compile_counts stays fixed
         — test- and bench-asserted)."""
         ec = self.engine_config
-        widths = {ec.prefill_chunk}
-        w = 1
-        while w < ec.prefill_chunk:
-            widths.add(w)
-            w *= 2
-        # the pad-forward bucket is capped at the slot row bound, so a
-        # short pool folds the over-wide buckets into one (possibly
-        # non-power-of-two) max_request_len-wide shape
-        widths = {min(w, ec.max_request_len) for w in widths}
-        if ec.pool_role == "decode":
-            widths = set()  # no prefill shape ever dispatches here
+        # the bucket universe is computed once in __init__ (shared with
+        # the autotuner's fused-budget envelope): the configured chunk
+        # plus smaller powers of two, capped at the slot row bound;
+        # empty on a decode-role pool
+        widths = self._warmed_widths
         s = ec.num_slots
         one = jnp.zeros((1,), jnp.int32)
         zeros_s = jnp.zeros((s,), jnp.int32)
@@ -1339,8 +1428,10 @@ class ServingEngine:
             # mixed shapes only for widths that can actually ride
             # fused: step() routes any chunk wider than the budget to
             # the standalone path, so warming those would burn the most
-            # expensive compiles on unreachable shapes
-            if ec.mixed and width <= self._mixed_budget:
+            # expensive compiles on unreachable shapes.  Under autotune
+            # EVERY width warms — the tuned budget may move up to any
+            # warmed bucket, and a budget change must never compile
+            if ec.mixed and (ec.autotune or width <= self._mixed_budget):
                 _, _, pk, pv = self._mixed_step(
                     self.params, self.pool.k, self.pool.v,
                     jnp.zeros((1, self._table_width), jnp.int32), one,
@@ -1378,18 +1469,18 @@ class ServingEngine:
                 jnp.zeros((s,), jnp.float32),
                 jnp.zeros((s, ec.decode_span, 2), jnp.uint32), zeros_s)
             self.pool = replace(self.pool, k=pk, v=pv)
-        if self._loop_step is not None:
-            # the device loop's ONE shape (K is baked in; lane masks,
-            # budgets, and the units-ran count are all dynamic).  The
-            # all-inactive warmup call exits at unit 0 — the loop cond
-            # checks any(alive) precisely so warmup costs one compile
-            # and zero scratch-block work.
-            _, _, pk, pv = self._loop_step(
+        for k_depth, loop_step in sorted(self._loop_steps.items()):
+            # one shape per warmed loop depth (K is baked in; lane
+            # masks, budgets, and the units-ran count are all
+            # dynamic).  The all-inactive warmup call exits at unit 0
+            # — the loop cond checks any(alive) precisely so each
+            # depth costs one compile and zero scratch-block work.
+            _, _, pk, pv = loop_step(
                 self.params, self.pool.k, self.pool.v,
                 jnp.zeros((s, self._table_width), jnp.int32),
                 zeros_s, jnp.zeros((s,), bool), zeros_s,
                 jnp.zeros((s,), jnp.float32),
-                jnp.zeros((s, ec.steps_per_launch * ec.decode_span, 2),
+                jnp.zeros((s, k_depth * ec.decode_span, 2),
                           jnp.uint32),
                 zeros_s)
             self.pool = replace(self.pool, k=pk, v=pv)
@@ -1439,8 +1530,8 @@ class ServingEngine:
             "mixed_verify": self._mixed_verify_step._cache_size(),
             "copy": self._copy_step._cache_size(),
             "upload": self._upload_step._cache_size(),
-            "loop": (self._loop_step._cache_size()
-                     if self._loop_step is not None else 0),
+            "loop": sum(step._cache_size()
+                        for step in self._loop_steps.values()),
         }
 
     # ------------------------------------------------------------------
@@ -1664,11 +1755,22 @@ class ServingEngine:
             _histogram_samples(
                 spec_accept, "kubeshare_serving_spec_acceptance_ratio",
                 {"tenant": name}, counts, total, SPEC_ACCEPT_BUCKETS)
+        tuner = MetricFamily(
+            "kubeshare_serving_tuner_decisions_total",
+            "Autotuner knob decisions by knob and direction (up / "
+            "down = an in-envelope value applied; rejected = the "
+            "central sandbox refused an out-of-envelope proposal).  "
+            "Empty with autotune off.", "counter")
+        if self._tuner is not None:
+            for (knob, direction), n in sorted(
+                    self._tuner.decisions.items()):
+                tuner.add({"knob": knob, "direction": direction,
+                           **plabel}, n)
         return [req, blocks, tokens, dispatches, loop_units, host_s,
                 planner, prefix, hit_tokens, evicted, tier_blocks,
                 tier_req, tier_tokens, tier_bytes, tier_stall, ttft,
                 t_depth, t_blocks, t_tokens, preempt, cls_ttft, tbt,
-                coll_bytes, spec_tokens, spec_accept]
+                coll_bytes, spec_tokens, spec_accept, tuner]
 
     def serve_metrics(self, port: int = 0) -> MetricServer:
         """Start the textfile HTTP scrape endpoint (``/metrics`` and
@@ -2107,7 +2209,7 @@ class ServingEngine:
             # whose proposals miss halve down within a few rounds of
             # the acceptance EMA.
             slot.drafter = NGramDrafter(ec.draft_ngram, pending.prompt)
-            slot.draft_width = ec.draft_len
+            slot.draft_width = min(ec.draft_len, self._draft_width_cap)
             slot.accept_rate = 0.5
             if self.prefix_index is not None:
                 # a cache-hit lane has seen this movie: the trie's
@@ -2385,11 +2487,16 @@ class ServingEngine:
         loop_units, collective byte charges) is deferred to
         :meth:`_consume_inflight`."""
         ec = self.engine_config
-        n_steps = ec.steps_per_launch * ec.decode_span
+        # the EFFECTIVE depth — the autotuner may have lowered it below
+        # the configured ceiling; every reachable depth is a warmed
+        # shape, so the selection never compiles
+        k_depth = self._loop_k
+        n_steps = k_depth * ec.decode_span
         tables, lengths, active, tokens, temps, keys, budgets = \
             self._decode_lanes(decode_slots, n_steps)
         ring, units, pk, pv = self._dispatch(
-            self._loop_step, self.params, self.pool.k, self.pool.v,
+            self._loop_steps[k_depth], self.params, self.pool.k,
+            self.pool.v,
             jnp.asarray(tables), jnp.asarray(lengths), jnp.asarray(active),
             jnp.asarray(tokens), jnp.asarray(temps), jnp.asarray(keys),
             jnp.asarray(budgets))
@@ -2705,7 +2812,14 @@ class ServingEngine:
             if k:
                 rate = m / k
                 slot.accept_rate = 0.5 * slot.accept_rate + 0.5 * rate
-                if slot.accept_rate >= 0.75:
+                if self._tuner is not None:
+                    # autotune replaces the fixed doubling rule: the
+                    # cost model's expected-tokens-per-dispatch argmax
+                    # over warmed widths up to the tuned cap (the EMA
+                    # stays maintained above as the rule's input)
+                    slot.draft_width = self._tuner.lane_draft_width(
+                        slot.accept_rate, self._draft_width_cap)
+                elif slot.accept_rate >= 0.75:
                     slot.draft_width = min(slot.draft_width * 2,
                                            ec.draft_len)
                 elif slot.accept_rate <= 0.25:

@@ -64,9 +64,11 @@ import jax
 
 from ..parallel.mesh import MeshSpec
 from ..utils.promtext import MetricFamily, Sample
+from .autotune import AutoTuner
 from .engine import (EngineConfig, Request, RequestResult, ServingEngine,
                      TTFT_BUCKETS, _bucket_observe, _histogram_samples)
 from .kv_tier import HostTier, LRUTierPolicy, QoSTierPolicy
+from .metrics_view import HistogramWindow, interval_quantile
 from .qos import TenantRegistry
 from .sharded import carve_replica_groups
 
@@ -88,20 +90,14 @@ def _pool_engines(eng) -> list:
 
 def _interval_quantile(counts, q: float,
                        bounds=TTFT_BUCKETS) -> Optional[float]:
-    """Histogram-bucket quantile over INTERVAL counts (the PromQL
+    """Histogram-bucket quantile over INTERVAL counts, delegating to
+    the shared reader in :mod:`serving.metrics_view` (the PromQL
     ``histogram_quantile`` estimate, upper-bound flavored): None on an
     empty interval; observations in the +Inf tail report as infinite —
     any finite threshold treats that as a breach, which is the point."""
-    total = sum(counts)
-    if total == 0:
+    if sum(counts) == 0:
         return None
-    rank = q * total
-    cum = 0
-    for i, c in enumerate(counts):
-        cum += c
-        if c and cum >= rank:
-            return float(bounds[i]) if i < len(bounds) else float("inf")
-    return float("inf")
+    return interval_quantile(counts, q, bounds)
 
 
 @dataclass
@@ -267,15 +263,15 @@ class TTFTBreachPolicy(ScalingPolicy):
         self.idle_cycles = idle_cycles
         self.min_samples = min_samples
         self.quantile = quantile
-        self._prev: Optional[List[int]] = None
+        # this policy's OWN interval view over the fleet's cumulative
+        # TTFT buckets (serving/metrics_view.py) — the tuner holds a
+        # separate window, so neither clobbers the other's baseline
+        self._window = HistogramWindow()
         self._breaches = 0
         self._idle = 0
 
     def decide(self, fleet):
-        snap = fleet._ttft_counts_snapshot()
-        prev = self._prev if self._prev is not None else [0] * len(snap)
-        self._prev = snap
-        interval = [a - b for a, b in zip(snap, prev)]
+        interval = self._window.update(fleet._ttft_counts_snapshot())
         n = sum(interval)
         if n >= self.min_samples:
             p = _interval_quantile(interval, self.quantile)
@@ -420,6 +416,17 @@ class ReplicaFleet:
         self.scale_events: Dict[str, int] = {"up": 0, "down": 0}
         self._drain_counts = [0] * (len(DRAIN_BUCKETS) + 1)
         self._drain_sum = 0.0
+        # the fleet-level autotuner (serving/autotune.py): with
+        # autotune on and a TTFT-breach autoscaler installed, retune
+        # its breach threshold within the validated (init/4, init*4)
+        # range from the same interval TTFT reader the autoscaler
+        # itself uses (each holds its own metrics_view window)
+        self._tuner = (AutoTuner.for_fleet(
+            self, self.scaling, TTFT_BUCKETS,
+            interval=self.engine_config.autotune_interval)
+            if (self.engine_config.autotune
+                and isinstance(self.scaling, TTFTBreachPolicy))
+            else None)
         for _ in range(replicas):
             self._add_replica(count_event=False)
 
@@ -686,6 +693,8 @@ class ReplicaFleet:
             worked |= handle.engine.step()
         self._finish_drains()
         self._steps += 1
+        if self._tuner is not None:
+            self._tuner.tick()
         if self.scaling is not None \
                 and self._steps % self.autoscale_every == 0:
             self._autoscale_tick()
@@ -837,6 +846,21 @@ class ReplicaFleet:
         _histogram_samples(drain, "kubeshare_serving_fleet_drain_seconds",
                            {}, self._drain_counts, self._drain_sum,
                            DRAIN_BUCKETS)
+        if self._tuner is not None:
+            # the fleet tuner's own decisions join the merged tuner
+            # family (replica engines' samples carry replica labels,
+            # so scope=fleet samples never collide)
+            fam = merged.get("kubeshare_serving_tuner_decisions_total")
+            if fam is None:
+                fam = MetricFamily(
+                    "kubeshare_serving_tuner_decisions_total",
+                    "Autotuner knob decisions by knob and direction.",
+                    "counter")
+                merged[fam.name] = fam
+            for (knob, direction), n in sorted(
+                    self._tuner.decisions.items()):
+                fam.add({"knob": knob, "direction": direction,
+                         "scope": "fleet"}, n)
         return list(merged.values()) + [replicas, routing, scale, drain]
 
     @staticmethod

@@ -1,0 +1,376 @@
+"""Autotuner + shared metrics-view suite.
+
+The contracts under test are the ones that make ONLINE tuning safe on a
+serving engine whose invariants are already test-locked elsewhere:
+
+- the sandbox: a policy proposal outside the warmed-shape /
+  validated-range envelope (or from a crashing policy) is centrally
+  rejected — counted, never applied, never a recompile;
+- bit-exactness: tuner-on and tuner-off emit identical streams (greedy
+  AND sampled, across speculation/mixed/loop/disagg) because every knob
+  is scheduling-only;
+- zero recompiles with the tuner active — decisions are confined to
+  shapes warmup already compiled;
+- observability: tuner time is metered into
+  ``host_seconds_total{phase="tune"}`` and EXCLUDED from the planner's
+  phase, and every decision is exported by knob and direction;
+- determinism: the same recorded trace always fits the same cost model;
+- the consolidated EngineConfig validation table, including the new
+  fused-budget floor and tuning-interval rows.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeshare_tpu.models.transformer import TransformerConfig, transformer_init
+
+pytestmark = pytest.mark.serving
+
+
+def _small_config(**extra):
+    return TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32, attention="reference", **extra)
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = _small_config()
+    return config, transformer_init(jax.random.PRNGKey(0), config)
+
+
+def _engine(params, config, **overrides):
+    from kubeshare_tpu.serving import EngineConfig, ServingEngine
+
+    policy = overrides.pop("tuning_policy", None)
+    kwargs = dict(num_slots=3, block_size=4, num_blocks=41,
+                  max_request_len=48, prefill_chunk=8)
+    kwargs.update(overrides)
+    return ServingEngine(params, config, EngineConfig(**kwargs),
+                         tuning_policy=policy)
+
+
+def _requests(n=6, sampled=False, seed=0):
+    from kubeshare_tpu.serving import Request
+
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(n):
+        prompt = rng.integers(1, 64, size=int(rng.integers(3, 20))
+                              ).astype(np.int32)
+        extra = (dict(temperature=0.8, rng=jax.random.PRNGKey(100 + i))
+                 if sampled else {})
+        reqs.append(Request(f"r{i}", prompt, 10, **extra))
+    return reqs
+
+
+def _run(engine, reqs):
+    engine.warmup()
+    before = dict(engine.compile_counts())
+    for r in reqs:
+        engine.submit(r)
+    res = engine.run()
+    after = dict(engine.compile_counts())
+    assert after == before, f"recompiled after warmup: {before} -> {after}"
+    return {rid: list(r.tokens) for rid, r in sorted(res.items())}
+
+
+class TestMetricsView:
+    def test_histogram_window_first_call_is_full_history(self):
+        """The first update diffs against zero (PromQL increase():
+        a counter appearing IS an increase) — the fleet autoscaler's
+        original inline behavior, which its hysteresis tests pin."""
+        from kubeshare_tpu.serving import HistogramWindow
+
+        w = HistogramWindow()
+        assert w.update([3, 0, 2]) == [3, 0, 2]
+        assert w.update([4, 1, 2]) == [1, 1, 0]
+        # a second consumer holds its OWN baseline
+        w2 = HistogramWindow()
+        assert w2.update([4, 1, 2]) == [4, 1, 2]
+
+    def test_counter_window_diffs_per_key(self):
+        from kubeshare_tpu.serving import CounterWindow
+
+        w = CounterWindow()
+        assert w.update({"a": 5.0}) == {"a": 5.0}
+        assert w.update({"a": 7.0, "b": 2.0}) == {"a": 2.0, "b": 2.0}
+
+    def test_interval_quantile(self):
+        from kubeshare_tpu.serving import interval_quantile
+
+        bounds = (0.1, 0.5, 1.0)
+        assert interval_quantile([], 0.95, bounds) == 0.0
+        assert interval_quantile([0, 0, 0, 0], 0.95, bounds) == 0.0
+        # 10 in the first bucket: p95 is that bucket's upper bound
+        assert interval_quantile([10, 0, 0, 0], 0.95, bounds) == 0.1
+        # rank lands in the overflow tail
+        assert interval_quantile([1, 0, 0, 9], 0.95, bounds) == float("inf")
+
+    def test_hist_quantile_matches_bench_conventions(self):
+        from kubeshare_tpu.serving import hist_quantile
+
+        assert hist_quantile([], 0.5) is None
+        # all mass in (0, 0.1]: p50 interpolates to the midpoint
+        assert hist_quantile([(0.1, 10), (float("inf"), 10)], 0.5) \
+            == pytest.approx(0.05)
+        # mass in the +Inf tail reports the highest finite bound
+        assert hist_quantile([(0.1, 0), (float("inf"), 4)], 0.99) == 0.1
+
+
+class TestSandbox:
+    def test_knobspec_needs_exactly_one_envelope(self):
+        from kubeshare_tpu.serving import KnobSpec
+
+        with pytest.raises(ValueError, match="exactly one"):
+            KnobSpec("k")
+        with pytest.raises(ValueError, match="exactly one"):
+            KnobSpec("k", values=(1, 2), bounds=(0.0, 1.0))
+
+    def test_admits_rejects_out_of_envelope_and_bool(self):
+        from kubeshare_tpu.serving import KnobSpec
+
+        disc = KnobSpec("w", values=(1, 2, 4))
+        assert disc.admits(2) and not disc.admits(3)
+        assert not disc.admits(True)  # bool-is-int pun refused
+        cont = KnobSpec("t", bounds=(0.5, 2.0))
+        assert cont.admits(1.0) and not cont.admits(2.5)
+        assert not cont.admits("1.0")
+
+    def test_out_of_envelope_policy_is_rejected_centrally(self, model):
+        """A hostile policy proposing unwarmed shapes, unknown knobs,
+        and bool puns costs nothing: every proposal is counted
+        rejected, no knob moves, zero recompiles, and the stream
+        equals the tuner-off baseline."""
+        from kubeshare_tpu.serving import TuningPolicy
+
+        class Hostile(TuningPolicy):
+            def propose(self, signals, knobs, cost_model):
+                return {"mixed_prefill_budget": 999,
+                        "steps_per_launch": 3,
+                        "draft_width_cap": True,
+                        "no_such_knob": 1}
+
+        config, params = model
+        kwargs = dict(mixed=True, speculative=True, draft_len=4,
+                      steps_per_launch=4)
+        baseline = _run(_engine(params, config, **kwargs), _requests())
+        eng = _engine(params, config, autotune=True, autotune_interval=2,
+                      tuning_policy=Hostile(), **kwargs)
+        streams = _run(eng, _requests())
+        assert streams == baseline
+        assert eng._mixed_budget == 8  # untouched hand-set values
+        assert eng._loop_k == 4
+        assert eng._draft_width_cap == 4
+        dirs = {d for (_, d) in eng._tuner.decisions}
+        assert dirs == {"rejected"}
+        rejected = {k for (k, d) in eng._tuner.decisions}
+        assert rejected == {"mixed_prefill_budget", "steps_per_launch",
+                            "draft_width_cap", "no_such_knob"}
+        assert eng._tuner.trajectory == []
+
+    def test_crashing_policy_is_sandboxed(self, model):
+        from kubeshare_tpu.serving import TuningPolicy
+
+        class Crashing(TuningPolicy):
+            def propose(self, signals, knobs, cost_model):
+                raise RuntimeError("boom")
+
+        config, params = model
+        eng = _engine(params, config, mixed=True, autotune=True,
+                      autotune_interval=2, tuning_policy=Crashing())
+        streams = _run(eng, _requests(n=3))
+        assert len(streams) == 3
+        assert eng._tuner.decisions.get(("policy", "rejected"), 0) > 0
+
+
+class TestCostModel:
+    TRACE = [
+        ({"decode": 10.0, "prefill": 2.0}, 0.14),
+        ({"decode": 4.0, "prefill": 6.0}, 0.16),
+        ({"decode": 8.0, "prefill": 1.0}, 0.10),
+        ({"decode": 2.0, "prefill": 8.0}, 0.18),
+    ]
+
+    def test_fit_is_deterministic_from_a_recorded_trace(self):
+        from kubeshare_tpu.serving import CostModel, FittedTracePolicy
+
+        fits = []
+        for _ in range(2):
+            m = CostModel()
+            for row, secs in self.TRACE:
+                m.observe(row, secs)
+            fits.append(m.coefficients)
+        assert fits[0] == fits[1]
+        assert fits[0].keys() == {"decode", "prefill"}
+        assert all(c >= 0 for c in fits[0].values())
+        # the frozen trace-fitted policy carries the identical model
+        pol = FittedTracePolicy(self.TRACE)
+        assert pol.model.coefficients == fits[0]
+
+    def test_degenerate_trace_keeps_analytic_fallback(self):
+        from kubeshare_tpu.serving import CostModel
+
+        m = CostModel()
+        m.observe({"decode": 4.0}, 0.0)      # non-positive: dropped
+        m.observe({"decode": 0.0}, 1.0)      # empty interval: dropped
+        assert m.rows == [] and m.coefficients == {}
+        assert m.cost("mixed") == CostModel.DEFAULT_COSTS["mixed"]
+
+    def test_best_draft_width_deterministic_and_monotone(self):
+        from kubeshare_tpu.serving import CostModel
+
+        m = CostModel()
+        widths = (1, 2, 4, 8)
+        lo = m.best_draft_width(0.05, widths)
+        hi = m.best_draft_width(0.95, widths)
+        assert lo <= hi  # better acceptance never narrows the draft
+        assert hi == m.best_draft_width(0.95, widths)  # stable
+        assert m.expected_verify_tokens(0.0, 4) == pytest.approx(1.0)
+        assert m.expected_verify_tokens(1.0, 4) == pytest.approx(5.0)
+
+
+class TestTunerBitExact:
+    def _pair(self, model, sampled, **kwargs):
+        config, params = model
+        off = _run(_engine(params, config, **kwargs),
+                   _requests(sampled=sampled))
+        on = _run(_engine(params, config, autotune=True,
+                          autotune_interval=2, **kwargs),
+                  _requests(sampled=sampled))
+        assert on == off
+
+    def test_greedy_streams_bit_exact_across_subsystems(self, model):
+        """Mixed batching + speculation + the device loop all armed:
+        the tuner may move every engine knob and not one token may
+        change.  (_run also asserts zero recompiles per arm.)"""
+        self._pair(model, sampled=False, mixed=True, speculative=True,
+                   draft_len=4, steps_per_launch=4)
+
+    def test_sampled_streams_bit_exact_across_subsystems(self, model):
+        self._pair(model, sampled=True, mixed=True, speculative=True,
+                   draft_len=4, steps_per_launch=4, top_k=10, top_p=0.95)
+
+    def test_disagg_streams_bit_exact_with_router_tuner(self, model):
+        from kubeshare_tpu.serving import DisaggRouter, EngineConfig
+
+        config, params = model
+
+        def run(autotune):
+            kw = dict(num_slots=3, block_size=4, num_blocks=41,
+                      max_request_len=48, prefill_chunk=8,
+                      autotune=autotune, autotune_interval=2)
+            router = DisaggRouter(params, config, EngineConfig(**kw),
+                                  EngineConfig(**kw),
+                                  max_pending_handoffs=2,
+                                  decode_priority=2)
+            router.warmup()
+            before = dict(router.compile_counts())
+            for r in _requests():
+                router.submit(r)
+            res = router.run()
+            assert dict(router.compile_counts()) == before
+            return ({rid: list(v.tokens) for rid, v in sorted(res.items())},
+                    router)
+
+        off, _ = run(False)
+        on, router = run(True)
+        assert on == off
+        assert router._tuner is not None
+        # the router's reserve/pacing knobs stayed inside their ranges
+        assert 1 <= router._decode_priority <= 8
+        assert 1 <= router._max_pending_handoffs <= 3
+
+
+class TestObservability:
+    def test_tune_time_metered_and_excluded_from_plan(self, model):
+        """An artificially slow tuner tick lands its seconds in the
+        "tune" phase, not the planner's — the phase split is what makes
+        tuner overhead first-class observable."""
+        config, params = model
+        eng = _engine(params, config, mixed=True, autotune=True,
+                      autotune_interval=2)
+        orig = eng._tuner.tick
+
+        def slow_tick():
+            import time as _t
+            _t.sleep(0.003)
+            return orig()
+
+        eng._tuner.tick = slow_tick
+        _run(eng, _requests(n=3))
+        hs = eng.host_seconds
+        assert hs["tune"] > 0
+        # every slept millisecond was charged to "tune"; had it leaked
+        # into the planner, "plan" (microseconds of pure host logic per
+        # step on this tiny pool) would dwarf nothing — assert the
+        # split, not absolute wall numbers
+        assert hs["plan"] < hs["tune"]
+        metric = {(sm.name, tuple(sorted(sm.labels.items()))): sm.value
+                  for f in eng.collect_metrics() for sm in f.samples}
+        assert metric[("kubeshare_serving_host_seconds_total",
+                       (("phase", "tune"),))] == pytest.approx(hs["tune"])
+
+    def test_decisions_exported_by_knob_and_direction(self, model):
+        from kubeshare_tpu.serving import TuningPolicy
+
+        class Budget4(TuningPolicy):
+            def propose(self, signals, knobs, cost_model):
+                return {"mixed_prefill_budget": 4}
+
+        config, params = model
+        eng = _engine(params, config, mixed=True, autotune=True,
+                      autotune_interval=2, tuning_policy=Budget4())
+        _run(eng, _requests(n=3))
+        metric = {(sm.name, tuple(sorted(sm.labels.items()))): sm.value
+                  for f in eng.collect_metrics() for sm in f.samples}
+        assert metric[("kubeshare_serving_tuner_decisions_total",
+                       (("direction", "down"),
+                        ("knob", "mixed_prefill_budget")))] == 1
+        assert eng._mixed_budget == 4
+
+    def test_family_empty_with_autotune_off(self, model):
+        config, params = model
+        eng = _engine(params, config)
+        _run(eng, _requests(n=2))
+        fams = {f.name: f for f in eng.collect_metrics()}
+        assert fams["kubeshare_serving_tuner_decisions_total"].samples == []
+        assert "tune" in eng.host_seconds
+        assert eng.host_seconds["tune"] == 0.0
+
+
+class TestConfigValidationTable:
+    def test_autotune_interval_floor(self, model):
+        config, params = model
+        with pytest.raises(ValueError, match="autotune_interval"):
+            _engine(params, config, autotune=True, autotune_interval=0)
+
+    def test_budget_floor_is_loud(self, model):
+        config, params = model
+        with pytest.raises(ValueError, match="mixed_prefill_budget"):
+            _engine(params, config, mixed=True, mixed_prefill_budget=0)
+
+    def test_budget_floor_row_names_the_smallest_piece(self, model):
+        """The table row itself: an undersized budget is compared
+        against the smallest warmed chunk piece with the starvation
+        explanation in the message."""
+        from dataclasses import replace
+
+        from kubeshare_tpu.serving import EngineConfig
+        from kubeshare_tpu.serving.engine import _config_rows
+
+        config, _ = model
+        ec = replace(EngineConfig(num_slots=3, block_size=4, num_blocks=41,
+                                  max_request_len=48, prefill_chunk=8),
+                     mixed=True, mixed_prefill_budget=0)
+        fired = [msg for failed, msg in _config_rows(ec, config) if failed]
+        assert any("smallest warmed chunk piece" in m for m in fired)
+
+    def test_table_preserves_scattered_messages(self, model):
+        """Spot-check that consolidation kept the original inline
+        messages (other suites pin more of them)."""
+        config, params = model
+        with pytest.raises(ValueError, match="power of two"):
+            _engine(params, config, steps_per_launch=3)
