@@ -651,6 +651,37 @@ def fleet_settings() -> dict:
     )
 
 
+def chaos_smoke_settings() -> dict:
+    """Seconds-fast chaos path (CI, make serve-chaos-smoke): the fleet
+    smoke trace over a 2-replica fleet whose per-replica pool (16
+    allocatable blocks = 128 tokens) sits BELOW the 4-family shared-
+    prefix working set, so eviction pressure demotes warm prefixes to
+    the shared host tier before the kill — the state crash salvage
+    exists to recover.  The victim dies halfway through its fault-free
+    step count (measured, not guessed)."""
+    s = fleet_smoke_settings()
+    s.update(
+        num_blocks=33,  # 2 x 16 allocatable: tier pressure on purpose
+        shared_tier_bytes=1 << 22,
+        chaos_seed=7, chaos_victim="r1",
+    )
+    return s
+
+
+def chaos_settings() -> dict:
+    """The chaos capture configuration: the full fleet bench model and
+    trace with the per-replica pool halved (40 allocatable blocks vs
+    the 6 x 256-token family working set) so the shared tier holds real
+    salvage when the victim dies mid-trace."""
+    s = fleet_settings()
+    s.update(
+        num_blocks=81,  # 2 x 40 allocatable: below the working set
+        shared_tier_bytes=1 << 24,
+        chaos_seed=7, chaos_victim="r1",
+    )
+    return s
+
+
 def build_tiered_workload(s: dict):
     """Many-distinct-shared-prefixes trace: every request opens with
     one of ``num_prefixes`` common ``prefix_len``-token prefixes
@@ -1334,7 +1365,9 @@ def run_disagg(params, config, s: dict, trace, registry=None,
     }
 
 
-def run_fleet(params, config, s: dict, trace, routing=None) -> dict:
+def run_fleet(params, config, s: dict, trace, routing=None,
+              fault_clock=None, shared_tier_bytes=None,
+              on_step=None) -> dict:
     """Replica-fleet arm: one :class:`ReplicaFleet` of ``replicas``
     engines, each funded with 1/N of the monolithic arm's allocatable
     KV blocks, replayed with the same open-loop drive as
@@ -1342,7 +1375,15 @@ def run_fleet(params, config, s: dict, trace, routing=None) -> dict:
     :class:`PrefixAffinityPolicy`; the round-robin control passes
     ``RoundRobinPolicy()``.  Skipped-prefix and routing stats are read
     back through the merged metrics plane (the collector scrape
-    surface), not bench-side arithmetic."""
+    surface), not bench-side arithmetic.
+
+    ``fault_clock`` wires a chaos :class:`FaultClock` through the fleet
+    (and becomes its internal clock — recovery latency is then VIRTUAL
+    time, deterministic run to run); ``shared_tier_bytes`` stands up
+    the shared host tier crash salvage needs.  The fault-free chaos arm
+    passes an empty-plan clock so both arms share identical wiring.
+    ``on_step(fleet)`` runs once per drive iteration — the chaos bench
+    uses it to arm the kill only once the victim is mid-stream."""
     from kubeshare_tpu.serving import EngineConfig, ReplicaFleet, Request
 
     replicas = s["replicas"]
@@ -1355,7 +1396,8 @@ def run_fleet(params, config, s: dict, trace, routing=None) -> dict:
             max_request_len=s["max_request_len"],
             prefill_chunk=s["prefill_chunk"],
             decode_span=s.get("decode_span", 4)),
-        replicas=replicas, routing=routing)
+        replicas=replicas, routing=routing, fault_clock=fault_clock,
+        shared_tier_bytes=shared_tier_bytes)
     fleet.warmup()
     compiles_before = fleet.compile_counts()
 
@@ -1366,6 +1408,8 @@ def run_fleet(params, config, s: dict, trace, routing=None) -> dict:
         while pending and pending[0][3] <= now:
             rid, prompt, max_new, _ = pending.pop(0)
             fleet.submit(Request(rid, prompt, max_new))
+        if on_step is not None:
+            on_step(fleet)
         if not fleet.step() and pending:
             time.sleep(min(0.001, pending[0][3] - now))
     elapsed = time.monotonic() - start
@@ -1419,6 +1463,12 @@ def run_fleet(params, config, s: dict, trace, routing=None) -> dict:
         "per_replica_dispatches": per_replica_dispatches,
         "recompiles": recompiles,
         "requests": requests,
+        # health-monitor ledger (all zeros on a fault-free run)
+        "replica_failures": dict(fleet.replica_failures),
+        "salvaged_prefix_tokens": fleet.salvaged_tokens,
+        "salvage_candidate_tokens": fleet.salvage_candidate_tokens,
+        "orphans_readmitted": fleet.orphans_readmitted,
+        "recovery_durations_s": list(fleet.recovery_durations),
     }
 
 
@@ -1502,6 +1552,120 @@ def run_fleet_bench(s: dict, aba: bool = True) -> dict:
         "tokens_per_s_ratio": on["tokens_per_s"] / max(1e-9, off_tps),
         "streams_bit_exact": True,
         "recompiles_after_warmup": recompiles,
+        "platform": jax.default_backend(),
+    }
+
+
+def run_chaos_bench(s: dict) -> dict:
+    """Fault-tolerant fleet serving under an injected replica crash.
+
+    Two runs of one open-loop shared-prefix trace over the same
+    2-replica fleet + shared host tier: a FAULT-FREE arm (empty-plan
+    FaultClock — identical wiring, no faults) that doubles as the
+    oracle, and a CHAOS arm that kills one replica MID-STREAM — the
+    kill is armed through run_fleet's per-iteration hook the first
+    time the victim is decoding with at least 3/4 of the trace
+    submitted (late enough that eviction pressure has demoted whole
+    prefix chains to the tier), so the victim dies holding live slots
+    (not between arrivals, where recovery would have nothing to
+    prove).  The health
+    monitor must detect the death, salvage the victim's host-resident
+    trie to the survivor, and re-admit every orphaned stream through
+    the preemption-resume contract.  Hard-asserted, not reported:
+    EVERY stream of the chaos arm — including the victim's orphans —
+    is bit-exact with the fault-free arm, and neither arm recompiles
+    after warmup.  Reported: the salvage rate (adopted / host-resident
+    candidate tokens), recovery latency p50/p95 (virtual time:
+    deterministic), and the orphan/readmission ledger."""
+    from kubeshare_tpu.serving.chaos import FaultClock, FaultPlan
+
+    config, params = _bench_model(s)
+    trace, _ = build_fleet_workload(s)
+    tier = s["shared_tier_bytes"]
+
+    ref_clock = FaultClock(FaultPlan(seed=s["chaos_seed"]))
+    ref = run_fleet(params, config, s, trace, fault_clock=ref_clock,
+                    shared_tier_bytes=tier)
+    if ref["replica_failures"]:
+        raise RuntimeError(
+            f"fault-free arm recorded failures "
+            f"{ref['replica_failures']} — the empty plan injected "
+            f"nothing, so the monitor false-positived")
+
+    victim = s["chaos_victim"]
+    plan = FaultPlan(seed=s["chaos_seed"])
+    chaos_clock = FaultClock(plan)
+
+    def arm_kill(fleet):
+        if victim in plan.kills:
+            return
+        handle = fleet._handle(victim)
+        if handle.state != "active":
+            return
+        if len(fleet._results) < (3 * len(trace)) // 4:
+            return
+        decoding = [sl for sl in handle.engine._slots
+                    if sl.state == "decode" and len(sl.generated) >= 1]
+        if decoding:
+            plan.kill(victim,
+                      at_step=chaos_clock._steps.get(victim, 0))
+
+    chaos = run_fleet(params, config, s, trace, fault_clock=chaos_clock,
+                      shared_tier_bytes=tier, on_step=arm_kill)
+    kill_at = plan.kills.get(victim)
+    if kill_at is None:
+        raise RuntimeError(
+            f"the kill never armed — {victim!r} was never observed "
+            f"decoding after half the trace; the chaos trace needs "
+            f"re-pacing")
+
+    if not chaos["replica_failures"]:
+        raise RuntimeError(
+            f"planned kill of {victim!r} at step {kill_at} never "
+            f"detected — the health monitor is blind")
+    recompiles = ref["recompiles"] + chaos["recompiles"]
+    if recompiles:
+        raise RuntimeError(
+            f"{recompiles} recompilations after warmup across the "
+            f"chaos arms — recovery leaked a static shape")
+    mismatched = [
+        rid for rid, _, _, _ in trace
+        if chaos["requests"][rid]["tokens"]
+        != ref["requests"][rid]["tokens"]]
+    if mismatched:
+        raise RuntimeError(
+            f"streams diverged between the chaos and fault-free arms "
+            f"for {mismatched} — crash recovery is NOT bit-exact")
+    incomplete = [
+        rid for rid, _, max_new, _ in trace
+        if len(chaos["requests"][rid]["tokens"]) != max_new]
+    if incomplete:
+        raise RuntimeError(
+            f"streams {incomplete} did not run to their full budget "
+            f"under chaos — orphan re-admission dropped tokens")
+    for arm in (ref, chaos):
+        arm.pop("requests")
+    salvage_rate = (chaos["salvaged_prefix_tokens"]
+                    / max(1, chaos["salvage_candidate_tokens"]))
+    return {
+        "suite": "serving-chaos",
+        "metric": "bit-exact stream completion under an injected "
+                  "replica kill (hard-asserted vs the fault-free arm), "
+                  "with salvage rate and virtual-time recovery "
+                  "latency alongside",
+        "settings": {k: v for k, v in s.items()},
+        "victim": victim,
+        "kill_at_step": kill_at,
+        "fault_free": ref,
+        "chaos": chaos,
+        "fault_events": [list(e) for e in chaos_clock.events[:8]],
+        "streams_bit_exact": True,
+        "streams_completed": len(trace),
+        "salvage_rate": salvage_rate,
+        "recovery_s": _percentiles(chaos["recovery_durations_s"]),
+        "recompiles_after_warmup": recompiles,
+        "tokens_per_s_ratio": (chaos["tokens_per_s"]
+                               / max(1e-9, ref["tokens_per_s"])),
         "platform": jax.default_backend(),
     }
 
@@ -2429,6 +2593,12 @@ def main() -> None:
                              "(streams hard-asserted identical vs the "
                              "monolithic engine; aggregate prefix-skip "
                              "rate headline)")
+    parser.add_argument("--chaos", action="store_true",
+                        help="fault-tolerant fleet serving: kill a "
+                             "replica mid-trace and hard-assert every "
+                             "stream completes bit-exact vs the "
+                             "fault-free arm (salvage rate and "
+                             "recovery-latency headline)")
     parser.add_argument("--autotune", action="store_true",
                         help="cost-model autotuner vs hand-set knobs on "
                              "a three-phase shifting workload (streams "
@@ -2452,7 +2622,10 @@ def main() -> None:
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
             + " --xla_force_host_platform_device_count=2")
-    if args.autotune:
+    if args.chaos:
+        result = run_chaos_bench(
+            chaos_smoke_settings() if args.smoke else chaos_settings())
+    elif args.autotune:
         result = run_autotune_bench(
             autotune_smoke_settings() if args.smoke
             else autotune_settings())
@@ -2491,6 +2664,25 @@ def main() -> None:
     if args.json:
         with open(args.json, "w") as f:
             f.write(text + "\n")
+    if args.chaos:
+        ch = result["chaos"]
+        rec = result["recovery_s"]
+        print(f"\nchaos fleet (kill {result['victim']} mid-stream at "
+              f"its step {result['kill_at_step']}): "
+              f"{result['streams_completed']}/{result['streams_completed']} "
+              f"streams completed BIT-EXACT vs the fault-free arm "
+              f"(hard-asserted); cause "
+              f"{list(ch['replica_failures'].keys())}; "
+              f"{ch['orphans_readmitted']} orphaned streams "
+              f"re-admitted on the survivor; "
+              f"salvage rate {100 * result['salvage_rate']:.1f}% "
+              f"({ch['salvaged_prefix_tokens']}/"
+              f"{ch['salvage_candidate_tokens']} host-resident tokens "
+              f"adopted); recovery p50 {1e3 * rec['p50']:.2f} ms / "
+              f"p95 {1e3 * rec['p95']:.2f} ms (virtual time); "
+              f"tokens/s ratio {result['tokens_per_s_ratio']:.3f}; "
+              f"zero recompiles both arms", file=sys.stderr)
+        return
     if args.autotune:
         ph = result["phases"]
         marks = " ".join(

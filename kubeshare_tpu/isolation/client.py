@@ -15,20 +15,36 @@ from __future__ import annotations
 import ctypes
 import os
 import socket
+import time
+import zlib
 from typing import Optional, Tuple
 
 from .. import constants
 
 
 class TokenClient:
-    def __init__(self, host: str, port: int, pod_name: str, timeout: float = 60.0):
+    # Transient-failure retry policy: attempt 0 plus ``max_retries``
+    # retries, exponential backoff with deterministic jitter (seeded
+    # from pod_name so two pods never sync their retry storms, yet the
+    # same pod replays the same schedule).
+    BACKOFF_BASE_S = 0.05
+    BACKOFF_CAP_S = 1.0
+
+    def __init__(self, host: str, port: int, pod_name: str, timeout: float = 60.0,
+                 max_retries: int = 3):
+        if max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, got {max_retries}")
         self.host = host
         self.port = port
         self.pod_name = pod_name
         self.timeout = timeout
+        self.max_retries = max_retries
         self._sock: Optional[socket.socket] = None
         self._file = None
         self._blocking_ok = True  # cleared when the daemon lacks REQB
+        # chaos seam: a FaultClock here injects transient refusals
+        self.fault_clock = None
+        self.retry_counts = {"retried": 0, "recovered": 0, "exhausted": 0}
 
     # -- wire ----------------------------------------------------------
     def _connect(self) -> None:
@@ -39,8 +55,29 @@ class TokenClient:
         self._sock = sock
         self._file = sock.makefile("rw", newline="\n")
 
+    def _backoff_s(self, retry: int) -> float:
+        base = min(self.BACKOFF_CAP_S, self.BACKOFF_BASE_S * (2 ** retry))
+        jitter = zlib.crc32(f"{self.pod_name}:{retry}".encode()) % 1000 / 1000.0
+        return base * (0.75 + 0.5 * jitter)
+
+    def _sleep(self, seconds: float) -> None:
+        if self.fault_clock is not None:
+            self.fault_clock.advance(seconds)  # virtual time under chaos
+        else:
+            time.sleep(seconds)
+
     def _round_trip(self, request: str) -> str:
-        for _ in range(2):
+        verb = request.split(" ", 1)[0].strip()
+        last_error = "no attempt made"
+        for attempt in range(self.max_retries + 1):
+            if attempt > 0:
+                self.retry_counts["retried"] += 1
+                self._sleep(self._backoff_s(attempt - 1))
+            if (self.fault_clock is not None
+                    and self.fault_clock.on_tokend_request(verb)):
+                last_error = "injected transient refusal"
+                self.close()
+                continue
             try:
                 self._connect()
                 assert self._file is not None
@@ -48,11 +85,28 @@ class TokenClient:
                 self._file.flush()
                 reply = self._file.readline()
                 if reply:
+                    if attempt > 0:
+                        self.retry_counts["recovered"] += 1
                     return reply.strip()
-            except OSError:
-                pass
+                last_error = "connection closed by peer"
+            except OSError as e:
+                last_error = str(e) or type(e).__name__
             self.close()
-        raise ConnectionError(f"token endpoint {self.host}:{self.port} unreachable")
+        self.retry_counts["exhausted"] += 1
+        raise ConnectionError(
+            f"token endpoint {self.host}:{self.port} unreachable after "
+            f"{self.max_retries + 1} attempts ({verb}: {last_error})")
+
+    def collect_metrics(self):
+        """Retry counters as a prom family (lazy import keeps the wire
+        client free of a hard metrics dependency)."""
+        from ..utils.promtext import MetricFamily, Sample
+
+        return [MetricFamily(
+            "kubeshare_tokend_retries_total",
+            "Tokend round-trip retries by outcome.", "counter",
+            [Sample("kubeshare_tokend_retries_total", {"outcome": k}, float(v))
+             for k, v in sorted(self.retry_counts.items())])]
 
     # -- protocol ------------------------------------------------------
     # server-side park per blocking request; re-issued until granted

@@ -20,7 +20,7 @@ the same pod-deleted path every other workload uses."""
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
+from typing import Dict, Optional
 
 from .. import constants
 from ..cluster.api import Pod
@@ -74,6 +74,8 @@ class FleetPlacementPlane:
         self.priority = priority
         self.model = model
         self.pod_prefix = pod_prefix
+        # release-cause ledger: "retired" vs crash-recovery causes
+        self.release_causes: Dict[str, int] = {}
 
     def _pod_name(self, replica: str) -> str:
         return f"{self.pod_prefix}-{replica}"
@@ -118,9 +120,17 @@ class FleetPlacementPlane:
             manager_port=int(port) if port else None,
         )
 
-    def release(self, replica: str) -> None:
+    def release(self, replica: str, cause: str = "retired") -> None:
         """Delete the replica's pod — the scheduler's pod-deleted
         handler reclaims its cells, like any other workload's exit.
         Idempotent: releasing an unknown replica is a no-op (the pod
-        may already be gone)."""
+        may already be gone — which is exactly the crash-recovery
+        case: the fleet's health monitor releases a replica whose
+        process is already dead, and the reclaim is the same
+        pod-deleted path a voluntary retirement takes).  ``cause``
+        tags the release in :attr:`release_causes` ("retired" for
+        voluntary drain, "liveness"/"watchdog" from crash recovery) so
+        operators can tell planned churn from failures at the
+        placement plane."""
+        self.release_causes[cause] = self.release_causes.get(cause, 0) + 1
         self.cluster.delete_pod(self.namespace, self._pod_name(replica))

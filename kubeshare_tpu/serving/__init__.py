@@ -87,6 +87,7 @@ The serving subsystem the fractional-chip runtime was built to host:
 from .autotune import (AnalyticPolicy, AutoTuner, CostModel,
                        FittedTracePolicy, Knob, KnobSpec, KnobView,
                        TuningPolicy)
+from .chaos import FaultClock, FaultPlan, ReplicaKilled
 from .disagg import (DecodePool, DisaggRouter, DisaggTopology, KVMigrator,
                      PrefillPool)
 from .drafter import NGramDrafter
@@ -101,7 +102,8 @@ from .metrics_view import (CounterWindow, HistogramWindow, flatten_metrics,
                            hist_quantile, interval_quantile,
                            metric_histogram, metric_value)
 from .kv_tier import (KV_CHAIN_VERSION, KV_WIRE_VERSION, HostTier,
-                      LRUTierPolicy, QoSTierPolicy, TierPolicy, pack_block,
+                      LRUTierPolicy, QoSTierPolicy, TierPolicy,
+                      WireCorruption, pack_block,
                       pack_chain, unpack_block, unpack_chain,
                       wire_block_bytes)
 from .paged import (paged_copy_block, paged_decode_loop, paged_decode_span,
@@ -128,6 +130,8 @@ __all__ = [
     "DisaggTopology",
     "EngineConfig",
     "FairQueue",
+    "FaultClock",
+    "FaultPlan",
     "FittedTracePolicy",
     "HistogramWindow",
     "HostTier",
@@ -145,11 +149,13 @@ __all__ = [
     "PrefixIndex",
     "QoSTierPolicy",
     "TierPolicy",
+    "WireCorruption",
     "QOS_GUARANTEE",
     "QOS_OPPORTUNISTIC",
     "QuotaExceeded",
     "ReplicaFleet",
     "ReplicaHandle",
+    "ReplicaKilled",
     "Request",
     "RequestResult",
     "RoundRobinPolicy",

@@ -77,11 +77,12 @@ from ..parallel.distributed import (MultisliceSpec, multislice_spec_from_env,
 from ..utils.promtext import MetricFamily, Sample
 from .autotune import AutoTuner
 from .engine import (EngineConfig, Request, RequestResult, ServingEngine,
-                     _histogram_samples, _bucket_observe,
+                     _Pending, _histogram_samples, _bucket_observe,
                      plan_prefill_chunks)
 from .kv_blocks import BlockExhausted, QuotaExceeded, chain_token_runs
-from .kv_tier import (HostTier, LRUTierPolicy, QoSTierPolicy, pack_block,
-                      pack_chain, unpack_chain)
+from .kv_tier import (HostTier, LRUTierPolicy, QoSTierPolicy,
+                      WireCorruption, pack_block, pack_chain,
+                      unpack_chain)
 from .qos import TenantRegistry
 
 # Migration staging stall bounds: the HIDDEN cost is zero (device
@@ -196,6 +197,41 @@ class _Ticket:
     hint: Optional[List[int]] = None
     pack_stall_s: float = 0.0
     attempts: int = 0
+    # TTL/backoff bookkeeping (router step ordinals): the step the
+    # ticket was packed at, and the earliest step its next delivery
+    # attempt may run (exponential backoff after each failed attempt)
+    created_step: int = 0
+    next_attempt_step: int = 0
+
+
+def _ticket_resume_pending(ticket: _Ticket) -> _Pending:
+    """Turn an undeliverable ticket back into a queueable resume — the
+    preemption-resume contract at ``done=1`` (the first token was
+    emitted at prefill completion; everything after it is still owed).
+    The resume prompt appends that first token (the first uncached
+    token restart), the budget drops by one, and a sampled stream's
+    next emission consumes ``step_keys[0]`` — exactly the key the
+    delivered continuation would have consumed, so the re-prefilled
+    stream is bit-exact with the migrated one.  ``plan``/``needed``
+    are left empty: the caller re-plans with the admitting pool's
+    geometry (``_forward_resume`` does exactly that)."""
+    resume_prompt = np.concatenate(
+        [np.asarray(ticket.prompt, np.int32),
+         np.asarray([ticket.first_token], np.int32)])
+    remaining = ticket.max_new - 1
+    if ticket.temperature > 0.0:
+        sk = np.asarray(ticket.step_keys, np.uint32).reshape(-1, 2)
+        first_key = np.asarray(sk[0])
+        step_keys = np.asarray(sk[1:])
+    else:
+        first_key = np.zeros((2,), np.uint32)
+        step_keys = np.zeros((0, 2), np.uint32)
+    return _Pending(
+        rid=ticket.rid, tenant=ticket.tenant, prompt=resume_prompt,
+        max_new=remaining, temperature=ticket.temperature, plan=[],
+        needed=0, first_key=first_key, step_keys=step_keys,
+        emitted=list(ticket.emitted_prefix) + [int(ticket.first_token)],
+        last_token_at=ticket.last_token_at)
 
 
 class KVMigrator:
@@ -424,7 +460,21 @@ class DisaggRouter:
         max_pending_handoffs: Optional[int] = None,
         decode_priority: Optional[int] = None,
         replica_label: Optional[str] = None,
+        handoff_ttl_steps: Optional[int] = None,
+        handoff_backoff_steps: int = 1,
+        handoff_backoff_cap_steps: int = 8,
     ) -> None:
+        if handoff_ttl_steps is not None and handoff_ttl_steps < 1:
+            raise ValueError(
+                f"handoff_ttl_steps must be >= 1, got {handoff_ttl_steps}")
+        if handoff_backoff_steps < 1:
+            raise ValueError(
+                f"handoff_backoff_steps must be >= 1, got "
+                f"{handoff_backoff_steps}")
+        if handoff_backoff_cap_steps < handoff_backoff_steps:
+            raise ValueError(
+                f"handoff_backoff_cap_steps {handoff_backoff_cap_steps} "
+                f"is below handoff_backoff_steps {handoff_backoff_steps}")
         for name in _SHARED_GEOMETRY:
             pv, dv = (getattr(prefill_config, name),
                       getattr(decode_config, name))
@@ -498,6 +548,26 @@ class DisaggRouter:
             self.decode.on_tier_demote = self._mirror(self.prefill)
         self._tickets: List[_Ticket] = []
         self._results: Dict[str, RequestResult] = {}
+        # handoff TTL + bounded exponential backoff: a ticket that has
+        # been attempted at least once and sat undelivered for
+        # ``handoff_ttl_steps`` router steps EXPIRES — its decode
+        # reserve is released (the admission gate counts tickets, so
+        # popping it restores the reserve) and the request re-queues to
+        # prefill-from-cache via the done=1 resume contract.  Failed
+        # attempts back off ``base * 2^(attempts-1)`` steps, capped.
+        # None (default) keeps the legacy wait-forever behavior, where
+        # an undeliverable ticket with both pools idle is still a loud
+        # deadlock.
+        self._handoff_ttl = handoff_ttl_steps
+        self._handoff_backoff = handoff_backoff_steps
+        self._handoff_backoff_cap = handoff_backoff_cap_steps
+        self._steps = 0
+        self.handoff_retries: Dict[str, int] = {
+            "delivered": 0, "retried": 0, "expired": 0, "corrupt": 0,
+            "dropped": 0}
+        # chaos seam (serving/chaos.py): consulted before each delivery
+        # attempt; a False return models the handoff RPC lost in flight
+        self.fault_clock = None
         # eager-staging snapshot: the prefill pool object and per-rid
         # settled-token counts as of the END of the last step() — one
         # iteration stale, so reads against it never wait on in-flight
@@ -585,6 +655,7 @@ class DisaggRouter:
             t0 = time.monotonic()
             self._tuner.tick()
             self.decode.host_seconds["tune"] += time.monotonic() - t0
+        self._steps += 1
         worked = self._drain_tickets()
         if self._stage_pool is not None:
             # serialize a few already-final prompt blocks ahead of
@@ -611,11 +682,14 @@ class DisaggRouter:
         self._stage_settled = {
             s.rid: (s.plan[0][0] if s.plan else s.prompt.size)
             for s in self.prefill._slots if s.state == "prefill"}
-        if self._tickets and not worked:
+        if self._tickets and not worked and self._handoff_ttl is None:
             # nothing moved anywhere yet a ticket is stuck: with the
             # decode pool fully idle its reservation can never succeed
             # (submit() pre-checked sizing, so this is state corruption
-            # — fail loudly rather than spin)
+            # — fail loudly rather than spin).  With a TTL configured
+            # the ticket instead expires and re-queues within
+            # ``handoff_ttl_steps`` — quiet steps while it backs off
+            # are progress toward that, not a deadlock.
             raise RuntimeError(
                 f"migration deadlock: {len(self._tickets)} ticket(s) "
                 f"undeliverable with both pools idle (head: "
@@ -734,7 +808,18 @@ class DisaggRouter:
                     self._tuner.decisions.items()):
                 fam.add({"knob": knob, "direction": direction,
                          "pool": "router"}, n)
-        return list(merged.values()) + self.migrator.collect_metrics()
+        retries = MetricFamily(
+            "kubeshare_serving_handoff_retries_total",
+            "Handoff ticket delivery outcomes (delivered = admitted "
+            "decode-side; retried = decode pool full, backing off; "
+            "dropped = delivery attempt lost in flight [chaos]; "
+            "expired = TTL hit, decode reserve released and stream "
+            "re-queued to prefill-from-cache; corrupt = wire checksum "
+            "failed, stream re-queued to re-prefill)", "counter")
+        for outcome, n in sorted(self.handoff_retries.items()):
+            retries.add({"outcome": outcome}, n)
+        return (list(merged.values()) + self.migrator.collect_metrics()
+                + [retries])
 
     @staticmethod
     def _merge_samples(dst: MetricFamily, src: MetricFamily) -> None:
@@ -761,14 +846,50 @@ class DisaggRouter:
         the blocks right after) and queue the ticket; delivery is
         attempted at the next drain point so the prefill pool's step
         finishes first (the decode upload then overlaps it)."""
-        self._tickets.append(self.migrator.pack(self.prefill, slot))
+        ticket = self.migrator.pack(self.prefill, slot)
+        ticket.created_step = self._steps
+        self._tickets.append(ticket)
 
     def _drain_tickets(self) -> bool:
         progressed = False
+        now = self._steps
         while self._tickets:
             ticket = self._tickets[0]
-            if self.migrator.deliver(ticket):
+            if self._handoff_ttl is not None \
+                    and ticket.attempts > 0 \
+                    and now - ticket.created_step >= self._handoff_ttl:
+                # TTL expiry: pop the ticket (the admission gate counts
+                # tickets, so this releases its decode reserve) and
+                # re-queue the stream to prefill-from-cache
                 self._tickets.pop(0)
+                self._expire_ticket(ticket, "expired")
+                progressed = True
+                continue
+            if ticket.next_attempt_step > now:
+                break  # backing off; head-of-line FIFO is preserved
+            if self.fault_clock is not None \
+                    and not self.fault_clock.on_ticket_delivery(ticket):
+                # chaos: the delivery RPC was lost in flight — burn an
+                # attempt (drives backoff and the TTL's attempted-once
+                # precondition) and retry later
+                ticket.attempts += 1
+                self.handoff_retries["dropped"] += 1
+                self._set_backoff(ticket, now)
+                break
+            try:
+                delivered = self.migrator.deliver(ticket)
+            except WireCorruption:
+                # the packed chain rotted in flight: admit_migrated
+                # detected it BEFORE reserving anything decode-side, so
+                # the only loss is the wire bytes — re-queue the stream
+                # to re-prefill from clean device state
+                self._tickets.pop(0)
+                self._expire_ticket(ticket, "corrupt")
+                progressed = True
+                continue
+            if delivered:
+                self._tickets.pop(0)
+                self.handoff_retries["delivered"] += 1
                 progressed = True
                 continue
             spec = self.decode.tenants.get(ticket.tenant)
@@ -778,8 +899,31 @@ class DisaggRouter:
                 # (_forward_resume)
                 progressed = True
                 continue
+            self.handoff_retries["retried"] += 1
+            self._set_backoff(ticket, now)
             break
         return progressed
+
+    def _set_backoff(self, ticket: _Ticket, now: int) -> None:
+        """Bounded exponential backoff in router steps: attempt k waits
+        ``base * 2^(k-1)`` steps before retrying, capped — the decode
+        pool gets breathing room to free a slot without the router
+        hammering a full pool every iteration."""
+        backoff = min(self._handoff_backoff_cap,
+                      self._handoff_backoff
+                      * (2 ** max(0, ticket.attempts - 1)))
+        ticket.next_attempt_step = now + backoff
+
+    def _expire_ticket(self, ticket: _Ticket, outcome: str) -> None:
+        """An undeliverable (or corrupt) ticket's exit: count it, then
+        re-queue the stream through the done=1 resume contract — the
+        prompt was cached into the prefill trie at handoff, so the
+        re-prefill is a cache hit re-materializing K/V plus one new
+        token, and the stream stays bit-exact (the remaining key
+        schedule rides the pending entry)."""
+        self.handoff_retries[outcome] = \
+            self.handoff_retries.get(outcome, 0) + 1
+        self._forward_resume(ticket.tenant, _ticket_resume_pending(ticket))
 
     def _forward_resume(self, tenant: str, pending) -> None:
         """Decode-pool preemption hook: a victim's resume must

@@ -112,8 +112,9 @@ from .autotune import AnalyticPolicy, AutoTuner
 from .drafter import NGramDrafter
 from .kv_blocks import (BlockAllocator, BlockExhausted, QuotaExceeded,
                         init_paged_pool)
-from .kv_tier import (HostTier, LRUTierPolicy, QoSTierPolicy, pack_block,
-                      unpack_block, wire_block_bytes)
+from .kv_tier import (HostTier, LRUTierPolicy, QoSTierPolicy,
+                      WireCorruption, pack_block, unpack_block,
+                      wire_block_bytes)
 from .paged import (paged_copy_block, paged_decode_loop,
                     paged_decode_span, paged_mixed_step,
                     paged_mixed_verify_step, paged_prefill_step,
@@ -812,6 +813,15 @@ class ServingEngine:
         self.tier_hit_requests = 0
         self.tier_hit_tokens = 0
         self.tier_promotion_stall_s = 0.0
+        # wire blocks that failed their v2 crc32 on consumption — each
+        # was dropped (tier miss / failed delivery) and re-prefilled,
+        # never attended into a stream
+        self.tier_corrupt_blocks = 0
+        # chaos seam (serving/chaos.py): a FaultClock the engine
+        # CONSULTS — at the top of step() (replica kill) and inside
+        # _dispatch (slow/hung dispatch) — never a monkeypatch.  None
+        # outside chaos runs; the fleet/bench installs it.
+        self.fault_clock = None
         self._ttft_counts = [0] * (len(TTFT_BUCKETS) + 1)  # +Inf tail
         self._ttft_sum = 0.0
         # QoS counters: preemptions by victim tenant, emitted tokens by
@@ -1125,6 +1135,16 @@ class ServingEngine:
             raise ValueError(
                 f"migrated chain has {len(payloads)} blocks but the "
                 f"decode lifetime only spans {needed}")
+        # crc-validate every migrated frame BEFORE reserving or
+        # uploading anything: a corrupt chain must fail delivery with
+        # zero state mutated here — the migrator turns the raise into
+        # a failed delivery and the router's TTL path re-queues the
+        # request to prefill-from-cache
+        try:
+            frames = [unpack_block(p) for p in payloads]
+        except WireCorruption:
+            self.tier_corrupt_blocks += 1
+            raise
         evict_first = (set(self.tenants.opportunistic())
                        if spec.is_guarantee else None)
         try:
@@ -1134,8 +1154,7 @@ class ServingEngine:
                 evict_tenants_first=evict_first)
         except (BlockExhausted, QuotaExceeded):
             return False
-        for payload, dst in zip(payloads, blocks):
-            _, k_slab, v_slab = unpack_block(payload)
+        for (_, k_slab, v_slab), dst in zip(frames, blocks):
             pk, pv = self._dispatch(
                 self._upload_step, self.pool.k, self.pool.v,
                 jnp.asarray(dst, jnp.int32),
@@ -1188,6 +1207,12 @@ class ServingEngine:
         ``kubeshare_serving_host_seconds_total{phase}``) — the raw
         material for proving, not asserting, that the device-resident
         loop removes host overhead from the decode hot path."""
+        if self.fault_clock is not None:
+            # chaos seam: a planned replica kill raises ReplicaKilled
+            # HERE, before any host state mutates this step — the
+            # crashed engine's host-side records stay consistent for
+            # the fleet's recovery walk
+            self.fault_clock.on_engine_step(self)
         hs = self.host_seconds
         t0 = time.monotonic()
         self._admit()
@@ -1677,6 +1702,13 @@ class ServingEngine:
             "enqueue; the device copy-in itself overlaps the pipelined "
             "dispatch on an unguarded engine).", "counter")
         tier_stall.add({}, self.tier_promotion_stall_s)
+        tier_corrupt = MetricFamily(
+            "kubeshare_serving_tier_corruptions_total",
+            "Wire blocks that failed their v2 crc32 at consumption "
+            "(tier promotion or migration delivery) — each was dropped "
+            "and re-prefilled, never attended into a stream.",
+            "counter")
+        tier_corrupt.add({}, self.tier_corrupt_blocks)
         ttft = MetricFamily(
             "kubeshare_serving_ttft_seconds",
             "Time to first token (submit to first emitted token).",
@@ -1768,7 +1800,8 @@ class ServingEngine:
                            **plabel}, n)
         return [req, blocks, tokens, dispatches, loop_units, host_s,
                 planner, prefix, hit_tokens, evicted, tier_blocks,
-                tier_req, tier_tokens, tier_bytes, tier_stall, ttft,
+                tier_req, tier_tokens, tier_bytes, tier_stall,
+                tier_corrupt, ttft,
                 t_depth, t_blocks, t_tokens, preempt, cls_ttft, tbt,
                 coll_bytes, spec_tokens, spec_accept, tuner]
 
@@ -1901,6 +1934,34 @@ class ServingEngine:
                 f"{device} — index/tier state diverged")
         for hk in host_keys:
             self.host_tier.forget(hk)
+
+    def _validate_host_hit(self, hit: _PrefixHit):
+        """Deserialize (and crc-check) every host payload ``hit`` will
+        consume, returning ``{host_key: (tokens, k_slab, v_slab)}`` —
+        or None after dropping the corrupt entries (tier forget + trie
+        detach, counted in ``tier_corrupt_blocks``), in which case the
+        caller must retry the admission cold.  Validation-before-upload
+        is the point: a corrupt middle block detected after its
+        siblings uploaded would leave a half-promoted slot."""
+        slabs, bad = {}, []
+        nodes = list(hit.promote)
+        if hit.host_cow is not None:
+            nodes.append(hit.host_cow)
+        for node in nodes:
+            entry = self.host_tier.probe(node.host_key)
+            try:
+                slabs[node.host_key] = unpack_block(entry.payload)
+            except WireCorruption:
+                bad.append(entry)
+        if not bad:
+            return slabs
+        for entry in bad:
+            self.tier_corrupt_blocks += 1
+            if self.host_tier.probe(entry.key) is not None:
+                # a corrupt ancestor's detach may have already cascaded
+                # this entry out of the tier
+                self._drop_host_entry(entry)
+        return None
 
     def _match_prefix(self, pending: _Pending) -> Optional[_PrefixHit]:
         """Admission-time prefix lookup for one queued request (None =
@@ -2070,6 +2131,24 @@ class ServingEngine:
                     needed, pending.rid, tenant=spec.name,
                     quota=spec.kv_block_quota,
                     evict_tenants_first=evict_first)
+                # host payloads are deserialized (and crc-checked) here,
+                # BEFORE any of them uploads: a corrupt block is dropped
+                # from tier + trie and the whole admission retries COLD —
+                # a rotted host byte costs a re-prefill, never a
+                # partially-promoted slot or a corrupted stream
+                slabs = (self._validate_host_hit(hit)
+                         if hit is not None
+                         and (hit.promote or hit.host_cow is not None)
+                         else {})
+                if slabs is None:
+                    for k in pinned:
+                        self.host_tier.unpin(k)
+                    self.allocator.reclaim(blocks)
+                    if retained:
+                        self.allocator.reclaim(retained)
+                    hit = None
+                    plan, needed = pending.plan, pending.needed
+                    continue
                 break
             except QuotaExceeded:
                 for k in pinned:
@@ -2118,7 +2197,7 @@ class ServingEngine:
             t0 = time.monotonic()
             for node, dst in zip(hit.promote, blocks[:n_promote]):
                 entry = self.host_tier.take(node.host_key)
-                _, k_slab, v_slab = unpack_block(entry.payload)
+                _, k_slab, v_slab = slabs[node.host_key]
                 pk, pv = self._dispatch(
                     self._upload_step, self.pool.k, self.pool.v,
                     jnp.asarray(dst, jnp.int32),
@@ -2136,7 +2215,7 @@ class ServingEngine:
                 # the CoW copy); the entry stays host-side serving
                 # other matchers
                 entry = self.host_tier.peek(hit.host_cow.host_key)
-                _, k_slab, v_slab = unpack_block(entry.payload)
+                _, k_slab, v_slab = slabs[hit.host_cow.host_key]
                 pk, pv = self._dispatch(
                     self._upload_step, self.pool.k, self.pool.v,
                     jnp.asarray(blocks[n_promote], jnp.int32),
@@ -2321,6 +2400,11 @@ class ServingEngine:
         asynchronous, so host-side work (admission, the caller's
         arrival loop) overlaps device execution, and emitted tokens
         are read one step later in :meth:`_consume_inflight`."""
+        if self.fault_clock is not None:
+            # chaos seam: an injected slow/hung dispatch advances the
+            # fault clock's virtual time here, where the fleet's
+            # dispatch watchdog measures
+            self.fault_clock.on_dispatch(self)
         if self.guard is None:
             return fn(*args)
         self.guard.acquire()

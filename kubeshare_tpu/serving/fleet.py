@@ -61,12 +61,15 @@ from dataclasses import dataclass, replace
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
+import numpy as np
 
 from ..parallel.mesh import MeshSpec
 from ..utils.promtext import MetricFamily, Sample
 from .autotune import AutoTuner
+from .chaos import ReplicaKilled
 from .engine import (EngineConfig, Request, RequestResult, ServingEngine,
-                     TTFT_BUCKETS, _bucket_observe, _histogram_samples)
+                     TTFT_BUCKETS, _Pending, _bucket_observe,
+                     _histogram_samples, plan_prefill_chunks)
 from .kv_tier import HostTier, LRUTierPolicy, QoSTierPolicy
 from .metrics_view import HistogramWindow, interval_quantile
 from .qos import TenantRegistry
@@ -78,6 +81,15 @@ from .sharded import carve_replica_groups
 # admitted; the 30s+ tail is a stuck lane, not a drain.
 DRAIN_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
 
+# Recovery-duration bucket bounds: last proof of life -> recovery
+# complete (detection latency INCLUDED — the grace epochs are part of
+# what a user-visible stall costs, so hiding them would flatter the
+# number).  Under a virtual FaultClock a step is ~1ms, so healthy
+# recoveries land in the low-millisecond buckets; the 1s+ tail means
+# detection took real wall-clock somewhere.
+RECOVERY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                    0.25, 0.5, 1.0, 5.0)
+
 
 def _pool_engines(eng) -> list:
     """The raw ServingEngine(s) behind a replica: the engine itself, or
@@ -86,6 +98,46 @@ def _pool_engines(eng) -> list:
     if hasattr(eng, "_ttft_counts"):
         return [eng]
     return [eng.prefill, eng.decode]
+
+
+def _slot_resume_pending(slot) -> _Pending:
+    """The ``_preempt`` resume arithmetic, computed purely host-side
+    from a DEAD engine's slot (no device reads, no allocator work —
+    the crashed replica's pool is gone and its blocks die with it).
+
+    With ``done`` tokens emitted, the cache-independent resume is:
+    prompt becomes ``prompt + generated`` (its last token is the first
+    uncached one), budget becomes ``max_new - done``, and a sampled
+    lane's next emission consumes ``step_keys[done - 1]`` — exactly the
+    key the unperturbed run would have used, which is what makes the
+    recovered stream bit-exact.  A slot that emitted nothing yet
+    (prefill state) resumes as its own admission: the key schedule the
+    engine derived at admit time rides along verbatim.  ``plan`` and
+    ``needed`` are left empty — the survivor re-plans with its own
+    geometry at placement."""
+    done = len(slot.generated)
+    if done == 0:
+        resume_prompt = np.asarray(slot.prompt, np.int32)
+        remaining = slot.max_new
+        first_key = slot.first_key
+        step_keys = slot.step_keys
+        emitted = list(slot.emitted_prefix)
+    else:
+        resume_prompt = np.concatenate(
+            [slot.prompt, np.asarray(slot.generated, np.int32)])
+        remaining = slot.max_new - done
+        if slot.temperature > 0.0:
+            first_key = np.asarray(slot.step_keys[done - 1])
+            step_keys = np.asarray(slot.step_keys[done:])
+        else:
+            first_key = np.zeros((2,), np.uint32)
+            step_keys = np.zeros((0, 2), np.uint32)
+        emitted = slot.emitted_prefix + slot.generated
+    return _Pending(
+        rid=slot.rid, tenant=slot.tenant, prompt=resume_prompt,
+        max_new=remaining, temperature=slot.temperature, plan=[],
+        needed=0, first_key=first_key, step_keys=step_keys,
+        emitted=emitted, last_token_at=slot.last_token_at)
 
 
 def _interval_quantile(counts, q: float,
@@ -103,10 +155,19 @@ def _interval_quantile(counts, q: float,
 @dataclass
 class ReplicaHandle:
     """One replica's lifecycle record.  ``state`` walks active ->
-    draining -> retired; the engine reference is kept after retirement
-    so ``compile_counts``/``collect_metrics`` still cover it (its
-    counters are final — a production deployment would drop the ref and
-    the device memory with it)."""
+    draining -> retired on the healthy path; ``failed`` is the crash
+    exit (reachable from active or draining) — a failed replica's cell
+    and device group are reclaimed and its requests re-admitted
+    elsewhere, but the engine reference is kept, exactly as for
+    retirement, so ``compile_counts``/``collect_metrics`` still cover
+    its final counters (a production deployment would drop the ref and
+    the device memory with it).
+
+    ``last_live_at``/``missed_epochs``/``watchdog_trips`` are the
+    health monitor's per-replica ledger: the last instant the replica
+    completed a step within budget, consecutive steps that raised
+    :class:`~kubeshare_tpu.serving.chaos.ReplicaKilled`, and
+    consecutive steps that blew the dispatch watchdog budget."""
 
     name: str
     engine: object
@@ -115,6 +176,10 @@ class ReplicaHandle:
     uses_fleet_tier: bool = False
     drain_started: Optional[float] = None
     placement: object = None
+    last_live_at: Optional[float] = None
+    missed_epochs: int = 0
+    watchdog_trips: int = 0
+    fail_cause: Optional[str] = None
 
 
 # ---------------------------------------------------------------------------
@@ -341,9 +406,22 @@ class ReplicaFleet:
         ledger_hook=None,
         replica_factory: Optional[Callable] = None,
         clock: Callable[[], float] = time.monotonic,
+        fault_clock=None,
+        liveness_grace: int = 2,
+        watchdog_budget_s: Optional[float] = None,
+        watchdog_grace: int = 2,
     ) -> None:
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if liveness_grace < 1:
+            raise ValueError(
+                f"liveness_grace must be >= 1, got {liveness_grace}")
+        if watchdog_grace < 1:
+            raise ValueError(
+                f"watchdog_grace must be >= 1, got {watchdog_grace}")
+        if watchdog_budget_s is not None and watchdog_budget_s <= 0:
+            raise ValueError(
+                f"watchdog_budget_s must be > 0, got {watchdog_budget_s}")
         if min_replicas < 1 or min_replicas > replicas:
             raise ValueError(
                 f"min_replicas must be in [1, replicas={replicas}], "
@@ -368,7 +446,36 @@ class ReplicaFleet:
         self._guard = guard
         self._replica_factory = replica_factory
         self._ledger_hook = ledger_hook
+        # chaos seam (serving/chaos.py): the fault clock is installed
+        # on every pool engine at build time, and — unless the caller
+        # pinned a clock of their own — its virtual ``now`` becomes the
+        # fleet's clock, so watchdog timing, drain durations, and
+        # recovery latency are all deterministic under injection
+        self.fault_clock = fault_clock
+        if fault_clock is not None and clock is time.monotonic:
+            clock = fault_clock.now
         self._clock = clock
+        # health monitor: a replica is declared dead after
+        # ``liveness_grace`` consecutive steps raising ReplicaKilled,
+        # or — with a watchdog budget set — ``watchdog_grace``
+        # consecutive steps whose wall (or virtual) time blew the
+        # budget (the hung-dispatch signature; a single slow step is
+        # NOT a failure, which the false-positive test pins down)
+        self.liveness_grace = liveness_grace
+        self.watchdog_budget_s = watchdog_budget_s
+        self.watchdog_grace = watchdog_grace
+        self.replica_failures: Dict[str, int] = {}
+        self.salvaged_tokens = 0
+        # denominator for the bench's salvage rate: tokens of every
+        # host-resident node a dead replica HELD (salvageable in
+        # principle), whether or not a survivor adopted it
+        self.salvage_candidate_tokens = 0
+        # exact recovery latencies (the histogram buckets coarsen;
+        # the chaos bench reports true p50/p95 from these)
+        self.recovery_durations: List[float] = []
+        self.orphans_readmitted = 0
+        self._recovery_counts = [0] * (len(RECOVERY_BUCKETS) + 1)
+        self._recovery_sum = 0.0
         # each replica serves ~1/N of the traffic, so each gets a 1/N
         # view of every tenant's KV quota (scale-ups reuse the same
         # fraction: the aggregate contract loosens as the fleet grows,
@@ -386,6 +493,8 @@ class ReplicaFleet:
             self.shared_tier = HostTier(shared_tier_bytes, policy,
                                         on_drop=self._route_drop,
                                         ledger_hook=ledger_hook)
+            if fault_clock is not None:
+                self.shared_tier.fault_clock = fault_clock
 
         # dp carving: a dp>1 mesh_spec names this fleet's device budget
         self._groups: Optional[List[list]] = None
@@ -478,6 +587,10 @@ class ReplicaFleet:
             uses_tier = self.shared_tier is not None
         handle = ReplicaHandle(name=name, engine=eng, group_idx=group_idx,
                                uses_fleet_tier=uses_tier)
+        handle.last_live_at = self._clock()
+        if self.fault_clock is not None:
+            for pool_eng in _pool_engines(eng):
+                pool_eng.fault_clock = self.fault_clock
         if uses_tier:
             eng.on_tier_demote = self._mirror_from(handle)
         if self.placement is not None:
@@ -526,7 +639,8 @@ class ReplicaFleet:
         """Add one replica (placed, tier-wired, warmed).  Loud when the
         fleet is at max_replicas or out of device groups — the
         autoscaler pre-checks :meth:`can_grow` instead of catching."""
-        live = sum(1 for h in self._replicas if h.state != "retired")
+        live = sum(1 for h in self._replicas
+                   if h.state not in ("retired", "failed"))
         if self.max_replicas is not None and live >= self.max_replicas:
             raise RuntimeError(
                 f"fleet is at max_replicas={self.max_replicas} "
@@ -534,7 +648,8 @@ class ReplicaFleet:
         return self._add_replica(count_event=True, warmup=warmup)
 
     def can_grow(self) -> bool:
-        live = sum(1 for h in self._replicas if h.state != "retired")
+        live = sum(1 for h in self._replicas
+                   if h.state not in ("retired", "failed"))
         if self.max_replicas is not None and live >= self.max_replicas:
             return False
         if self._groups is not None and not self._free_groups:
@@ -572,6 +687,180 @@ class ReplicaFleet:
                 self.placement.release(handle.name)
             if handle.group_idx is not None:
                 self._free_groups.append(handle.group_idx)
+
+    # ------------------------------------------------------------------
+    # crash recovery
+    # ------------------------------------------------------------------
+    def _recover_replica(self, handle: ReplicaHandle, cause: str) -> None:
+        """The pod-died path, end to end.  Ordering matters:
+
+        1. mark the replica failed (every later walk skips it);
+        2. SALVAGE the host-tier slice of its radix trie into the
+           survivors' tries — first, so the re-admitted orphans below
+           can prefix-hit whatever survived;
+        3. re-admit its queued and in-flight requests on survivors via
+           the preemption-resume contract (bit-exact by construction:
+           the emitted tokens, the remaining PRNG key schedule, and
+           the first-uncached-token restart all ride along);
+        4. reclaim the control-plane cell through the placement
+           plane's pod-deleted path and return the device group to the
+           carve, exactly as retirement does.
+
+        Recovery latency is measured last-proof-of-life -> recovery
+        complete, so the grace epochs' detection cost is included."""
+        handle.state = "failed"
+        handle.fail_cause = cause
+        self.replica_failures[cause] = \
+            self.replica_failures.get(cause, 0) + 1
+        self.salvaged_tokens += self._salvage_trie(handle)
+        self.orphans_readmitted += self._readmit_orphans(handle)
+        if self.placement is not None:
+            self.placement.release(handle.name, cause=cause)
+        if handle.group_idx is not None:
+            self._free_groups.append(handle.group_idx)
+            handle.group_idx = None
+        now = self._clock()
+        dur = max(0.0, now - (handle.last_live_at
+                              if handle.last_live_at is not None else now))
+        _bucket_observe(self._recovery_counts, dur, RECOVERY_BUCKETS)
+        self._recovery_sum += dur
+        self.recovery_durations.append(dur)
+
+    def _salvage_trie(self, handle: ReplicaHandle) -> int:
+        """Crash-time twin of :meth:`_handoff_trie`: the dead replica's
+        DEVICE blocks died with it, so only trie nodes whose payloads
+        already reached the SHARED host tier are snapshotted, forgotten
+        from the retiree's own tier budget, and offered to every active
+        surviving trie in BFS order (a peer adopts a node only when it
+        already holds the node's ancestors — from its own cache or an
+        earlier mirror — so deep salvage rides on what the survivor
+        knows).  Returns the number of prompt tokens whose K/V landed
+        in at least one survivor, the ``salvaged_prefix_tokens_total``
+        raw count."""
+        if self.shared_tier is None or not handle.uses_fleet_tier:
+            return 0
+        entries: List[tuple] = []  # (path_tokens, payload, tenant, ntok)
+        own_keys: List[int] = []
+        for eng in _pool_engines(handle.engine):
+            idx = getattr(eng, "prefix_index", None)
+            if idx is None:
+                continue
+            queue = (list(idx._root.children.values())
+                     + list(idx._root.partials))
+            i = 0
+            while i < len(queue):
+                node = queue[i]
+                i += 1
+                if node.host_key is not None:
+                    entry = self.shared_tier.probe(node.host_key)
+                    if entry is not None:
+                        entries.append(
+                            (idx.path_tokens(node), entry.payload,
+                             entry.tenant, len(node.tokens)))
+                        own_keys.append(node.host_key)
+                queue.extend(list(node.children.values()) + node.partials)
+        for key in own_keys:
+            self.shared_tier.forget(key)
+        peers = [p for p in self._replicas
+                 if p is not handle and p.state == "active"
+                 and p.uses_fleet_tier]
+        salvaged = 0
+        for tokens, payload, tenant, ntok in entries:
+            self.salvage_candidate_tokens += ntok
+            adopted_any = False
+            for peer in peers:
+                key = self.shared_tier.put(payload, tenant, None)
+                if key is None:
+                    continue
+                adopted = peer.engine.prefix_index.adopt_host(tokens, key)
+                if adopted is None:
+                    self.shared_tier.forget(key)
+                else:
+                    self.shared_tier.bind_node(key, adopted)
+                    adopted_any = True
+            if adopted_any:
+                salvaged += ntok
+        return salvaged
+
+    def _readmit_orphans(self, handle: ReplicaHandle) -> int:
+        """Re-admit every request the dead replica was holding — its
+        in-flight slots (by the ``_preempt`` arithmetic, computed
+        host-side from the slot's own records: the device is gone but
+        the emitted tokens, key schedule, and prompt are host state),
+        its queued pendings (verbatim — a fresh pending re-derives the
+        identical key schedule from its rng), and, for a disagg-pair
+        replica, its undelivered migration tickets (the done=1 resume
+        the router's TTL expiry uses).  Each orphan is ROUTED like a
+        fresh arrival (affinity sees the salvaged prefixes), then
+        requeued at the FRONT of its lane on the survivor in original
+        admission order, carrying its original result object so
+        callers' references keep filling in."""
+        orphans: List[tuple] = []  # (pending, result)
+        for eng in _pool_engines(handle.engine):
+            for slot in eng._slots:
+                if slot.state == "free":
+                    continue
+                orphans.append((_slot_resume_pending(slot), slot.result))
+            for tenant, lane in getattr(eng, "_queue")._lanes.items():
+                while lane.items:
+                    pending = lane.items.popleft()[1]
+                    orphans.append((pending, eng._results[pending.rid]))
+        tickets = list(getattr(handle.engine, "_tickets", ()))
+        if tickets:
+            from .disagg import _ticket_resume_pending
+            for ticket in tickets:
+                orphans.append(
+                    (_ticket_resume_pending(ticket), ticket.result))
+        if not orphans:
+            return 0
+        if not self._active():
+            raise RuntimeError(
+                f"replica {handle.name!r} failed ({handle.fail_cause}) "
+                f"holding {len(orphans)} request(s) with no active "
+                f"survivor to recover them onto")
+        placed = []
+        for pending, result in orphans:
+            probe = Request(
+                rid=pending.rid, prompt=pending.prompt,
+                max_new_tokens=pending.max_new,
+                temperature=pending.temperature, rng=pending.rng,
+                tenant=pending.tenant)
+            target, reason = self.routing.route(self, probe,
+                                                self._active())
+            self.routing_decisions[reason] = \
+                self.routing_decisions.get(reason, 0) + 1
+            placed.append((target, pending, result))
+        # requeue_front reverses arrival order, so walk the placements
+        # backwards: the earliest orphan ends up at the head of its
+        # survivor's lane
+        for target, pending, result in reversed(placed):
+            self._place_orphan(target, pending, result)
+        return len(placed)
+
+    def _place_orphan(self, handle: ReplicaHandle, pending: _Pending,
+                      result: RequestResult) -> None:
+        """Hand one orphaned pending to a survivor: re-plan it with the
+        survivor's geometry (the resume contract's re-plan, identical
+        to ``_preempt``'s), transplant the result object, and requeue
+        at the front of its tenant lane.  A disagg-pair survivor takes
+        it through its own ``_forward_resume`` (the resume must
+        re-prefill, which happens in that pair's prefill pool)."""
+        target = handle.engine
+        if hasattr(target, "_forward_resume"):
+            target._results[pending.rid] = result
+            target.prefill._results[pending.rid] = result
+            target._forward_resume(pending.tenant, pending)
+        else:
+            ec = target.engine_config
+            plan, cover = plan_prefill_chunks(
+                pending.prompt.size, ec.prefill_chunk, ec.max_request_len)
+            pending.plan = plan
+            pending.needed = target.allocator.blocks_for_tokens(
+                target._lifetime_rows(pending.prompt.size,
+                                      pending.max_new, cover))
+            target._results[pending.rid] = result
+            target._queue.requeue_front(pending.tenant, pending)
+        self._owner[pending.rid] = handle.name
 
     # ------------------------------------------------------------------
     # the cross-replica cache bus
@@ -655,7 +944,8 @@ class ReplicaFleet:
         if entry.node is None:
             return
         for handle in self._replicas:
-            if handle.state == "retired" or not handle.uses_fleet_tier:
+            if handle.state in ("retired", "failed") \
+                    or not handle.uses_fleet_tier:
                 continue
             if handle.engine.prefix_index.owns(entry.node):
                 handle.engine._drop_host_entry(entry)
@@ -682,15 +972,57 @@ class ReplicaFleet:
         return result
 
     def step(self) -> bool:
-        """One fleet iteration: advance every live replica, retire any
-        drain that completed, and consult the scaling policy on its
-        cadence.  Returns False only when every live replica is
-        drained-and-idle."""
+        """One fleet iteration: advance every live replica under the
+        health monitor, retire any drain that completed, and consult
+        the scaling policy on its cadence.  Returns False only when
+        every live replica is drained-and-idle.
+
+        The monitor is two independent detectors per replica.
+        LIVENESS: a step that raises
+        :class:`~kubeshare_tpu.serving.chaos.ReplicaKilled` (the
+        injected pod-death — raised before the step mutates host
+        state) is a missed epoch; ``liveness_grace`` consecutive
+        misses declare the replica dead.  WATCHDOG: with
+        ``watchdog_budget_s`` set, a step whose clock time blows the
+        budget is a trip; ``watchdog_grace`` consecutive trips declare
+        the replica hung (a hang makes "progress" every step — only
+        time catches it).  Both streaks reset on any healthy step, so
+        one slow dispatch or one transient miss never kills a replica.
+        Detection hands the handle to :meth:`_recover_replica`."""
         worked = False
         for handle in self._replicas:
-            if handle.state == "retired":
+            if handle.state in ("retired", "failed"):
                 continue
-            worked |= handle.engine.step()
+            t0 = self._clock()
+            healthy = False
+            try:
+                worked |= handle.engine.step()
+            except ReplicaKilled:
+                handle.missed_epochs += 1
+                # detection-in-progress IS work: the fleet must keep
+                # stepping until the grace budget declares the replica
+                # dead, even if every survivor is momentarily idle —
+                # otherwise run() could return with orphans stranded
+                worked = True
+            else:
+                handle.missed_epochs = 0
+                healthy = True
+            if self.watchdog_budget_s is not None \
+                    and self._clock() - t0 > self.watchdog_budget_s:
+                handle.watchdog_trips += 1
+            else:
+                handle.watchdog_trips = 0
+                if healthy:
+                    handle.last_live_at = self._clock()
+            cause = None
+            if handle.missed_epochs >= self.liveness_grace:
+                cause = "liveness"
+            elif self.watchdog_budget_s is not None \
+                    and handle.watchdog_trips >= self.watchdog_grace:
+                cause = "watchdog"
+            if cause is not None:
+                self._recover_replica(handle, cause)
+                worked = True
         self._finish_drains()
         self._steps += 1
         if self._tuner is not None:
@@ -743,7 +1075,7 @@ class ReplicaFleet:
     @property
     def idle(self) -> bool:
         return all(h.engine.idle for h in self._replicas
-                   if h.state != "retired")
+                   if h.state not in ("retired", "failed"))
 
     def result(self, rid: str) -> RequestResult:
         return self._results[rid]
@@ -764,7 +1096,7 @@ class ReplicaFleet:
 
     def warmup(self) -> None:
         for handle in self._replicas:
-            if handle.state != "retired":
+            if handle.state not in ("retired", "failed"):
                 handle.engine.warmup()
 
     def compile_counts(self) -> Dict[str, int]:
@@ -782,7 +1114,7 @@ class ReplicaFleet:
         replica — the autoscaler's interval-diff raw material."""
         counts = [0] * (len(TTFT_BUCKETS) + 1)
         for handle in self._replicas:
-            if handle.state == "retired":
+            if handle.state in ("retired", "failed"):
                 continue
             for eng in _pool_engines(handle.engine):
                 for i, c in enumerate(eng._ttft_counts):
@@ -817,7 +1149,7 @@ class ReplicaFleet:
                     merged[fam.name] = fam
                     continue
                 self._merge_samples(have, fam)
-        states = {"active": 0, "draining": 0, "retired": 0}
+        states = {"active": 0, "draining": 0, "retired": 0, "failed": 0}
         for handle in self._replicas:
             states[handle.state] += 1
         replicas = MetricFamily(
@@ -846,6 +1178,33 @@ class ReplicaFleet:
         _histogram_samples(drain, "kubeshare_serving_fleet_drain_seconds",
                            {}, self._drain_counts, self._drain_sum,
                            DRAIN_BUCKETS)
+        failures = MetricFamily(
+            "kubeshare_serving_fleet_replica_failures_total",
+            "Replicas declared dead by the health monitor, by cause "
+            "(liveness = consecutive crashed steps; watchdog = "
+            "consecutive over-budget steps, the hung-dispatch "
+            "signature)")
+        for cause, n in sorted(self.replica_failures.items()):
+            failures.add({"cause": cause}, n)
+        salvaged = MetricFamily(
+            "kubeshare_serving_fleet_salvaged_prefix_tokens_total",
+            "Prompt tokens whose K/V was recovered from a dead "
+            "replica's host-tier trie slice into at least one "
+            "survivor's trie")
+        salvaged.add({}, self.salvaged_tokens)
+        orphans = MetricFamily(
+            "kubeshare_serving_fleet_orphans_readmitted_total",
+            "Dead replicas' queued and in-flight requests re-admitted "
+            "on survivors through the preemption-resume contract")
+        orphans.add({}, self.orphans_readmitted)
+        recovery = MetricFamily(
+            "kubeshare_serving_fleet_recovery_seconds",
+            "Replica crash recovery latency: last proof of life to "
+            "recovery complete (salvage + orphan re-admission + cell "
+            "reclaim; detection grace included)", kind="histogram")
+        _histogram_samples(
+            recovery, "kubeshare_serving_fleet_recovery_seconds", {},
+            self._recovery_counts, self._recovery_sum, RECOVERY_BUCKETS)
         if self._tuner is not None:
             # the fleet tuner's own decisions join the merged tuner
             # family (replica engines' samples carry replica labels,
@@ -861,7 +1220,9 @@ class ReplicaFleet:
                     self._tuner.decisions.items()):
                 fam.add({"knob": knob, "direction": direction,
                          "scope": "fleet"}, n)
-        return list(merged.values()) + [replicas, routing, scale, drain]
+        return (list(merged.values())
+                + [replicas, routing, scale, drain, failures, salvaged,
+                   orphans, recovery])
 
     @staticmethod
     def _merge_samples(dst: MetricFamily, src: MetricFamily) -> None:

@@ -49,6 +49,7 @@ tiering off, test-locked like every other engine property.
 from __future__ import annotations
 
 import struct
+import zlib
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
@@ -56,9 +57,14 @@ import numpy as np
 
 # Wire format: magic + version first, so a receiver (this module today,
 # a cross-slice migration endpoint later) can reject foreign bytes
-# loudly before trusting a single field.
+# loudly before trusting a single field.  v2 appends a crc32 trailer
+# over everything before it: host RAM, a DCN hop, or a disk tier can
+# all hand back rotted bytes, and a checksum failure must surface as a
+# LOUD, typed error (:class:`WireCorruption`) the consumer can turn
+# into a tier miss — never as silently corrupted K/V rows attended
+# into a stream.
 KV_WIRE_MAGIC = b"KVWB"
-KV_WIRE_VERSION = 1
+KV_WIRE_VERSION = 2
 # Chain container (disaggregated prefill/decode migration unit,
 # serving/disagg.py): a counted sequence of length-prefixed pack_block
 # frames — one slot's whole block chain in one buffer.  Versioned
@@ -76,6 +82,18 @@ _FRAME_LEN = struct.Struct("<I")
 # 'bfloat16' resolves through ml_dtypes on any receiver.  Slabs are
 # always little-endian on the wire (ascii names carry no byte order).
 _HEADER = struct.Struct("<4sHHHHHHHH16s")
+# v2 integrity trailer: crc32 of every byte before it (header, tokens,
+# both slabs), little-endian u32 at the very end of the buffer.
+_CRC = struct.Struct("<I")
+
+
+class WireCorruption(ValueError):
+    """Wire bytes whose integrity checksum does not match — a flipped
+    bit anywhere in the buffer (header included) lands here, distinct
+    from the honest-foreign-bytes :class:`ValueError` a wrong
+    magic/version raises on an INTACT buffer.  Consumers (the engine's
+    promotion path, the migrator's delivery) catch exactly this type to
+    demote corruption to a tier miss; anything else stays fatal."""
 
 
 def _dtype_from_name(name: str) -> np.dtype:
@@ -92,7 +110,8 @@ def wire_block_bytes(n_tokens: int, n_layers: int, kv_heads: int,
     """Exact serialized size of one block — what a budget admission
     check needs WITHOUT materializing the payload."""
     return (_HEADER.size + 4 * n_tokens
-            + 2 * n_layers * kv_heads * block_size * head_dim * itemsize)
+            + 2 * n_layers * kv_heads * block_size * head_dim * itemsize
+            + _CRC.size)
 
 
 def pack_block(tokens, k_slab: np.ndarray, v_slab: np.ndarray) -> bytes:
@@ -123,18 +142,26 @@ def pack_block(tokens, k_slab: np.ndarray, v_slab: np.ndarray) -> bytes:
     header = _HEADER.pack(
         KV_WIRE_MAGIC, KV_WIRE_VERSION, _HEADER.size, n_layers, kv_heads,
         block_size, head_dim, toks.size, 0, dt.ljust(16, b"\0"))
-    return b"".join([
+    body = b"".join([
         header, toks.tobytes(),
         np.ascontiguousarray(k_slab).tobytes(),
         np.ascontiguousarray(v_slab).tobytes()])
+    return body + _CRC.pack(zlib.crc32(body) & 0xFFFFFFFF)
 
 
 def unpack_block(buf: bytes) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Inverse of :func:`pack_block`: ``(tokens, k_slab, v_slab)``.
-    Bit-identical round-trip (test-locked); loud on foreign magic or a
-    version this build does not speak."""
-    if len(buf) < _HEADER.size:
-        raise ValueError(f"wire block truncated at {len(buf)} bytes")
+    Bit-identical round-trip (test-locked); :class:`WireCorruption` on
+    a checksum mismatch (checked FIRST — a flipped bit may land in the
+    header, so no field is trusted before the crc passes), plain
+    :class:`ValueError` on intact-but-foreign magic or a version this
+    build does not speak."""
+    if len(buf) < _HEADER.size + _CRC.size:
+        raise WireCorruption(f"wire block truncated at {len(buf)} bytes")
+    (stored_crc,) = _CRC.unpack_from(buf, len(buf) - _CRC.size)
+    if zlib.crc32(memoryview(buf)[:-_CRC.size]) & 0xFFFFFFFF != stored_crc:
+        raise WireCorruption(
+            f"wire block checksum mismatch over {len(buf)} bytes")
     (magic, version, header_len, n_layers, kv_heads, block_size,
      head_dim, n_tokens, _reserved, dt) = _HEADER.unpack_from(buf)
     if magic != KV_WIRE_MAGIC:
@@ -341,6 +368,11 @@ class HostTier:
         # (e.g. TokenClient.request_memory's MEM verb, the exact ledger
         # the interposer charges).  None = no accounting.
         self.ledger_hook = ledger_hook
+        # chaos seam (serving/chaos.py): a FaultClock consulted on
+        # every put — it may return the payload with bytes flipped
+        # (rot-at-rest; the v2 crc catches it at consumption).  None
+        # outside chaos runs.
+        self.fault_clock = None
         self._entries: "OrderedDict[int, HostEntry]" = OrderedDict()
         self._pinned: Set[int] = set()
         self._next_key = 0
@@ -374,6 +406,8 @@ class HostTier:
         """Store one serialized block; returns its handle, or None when
         the policy refuses / room cannot be made (caller drops the
         block — the pre-tier destroy path)."""
+        if self.fault_clock is not None:
+            payload = self.fault_clock.on_tier_put(payload)
         need = len(payload)
         if need > self.budget_bytes or not self.policy.should_demote(tenant):
             self.refused_blocks += 1

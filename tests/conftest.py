@@ -49,6 +49,12 @@ def pytest_configure(config):
         "slow: excluded from the driver's tier-1 verify command "
         "(ROADMAP.md runs pytest with -m 'not slow')",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection suite (serving/chaos.py seams; "
+        "deterministic — virtual clocks, seeded faults; the heavyweight "
+        "chaos capture lives in benchmarks/serving_bench.py --chaos)",
+    )
 
 
 def _build_native() -> None:

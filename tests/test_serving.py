@@ -1516,7 +1516,7 @@ class TestKVTier:
         assert np.array_equal(k2, k) and k2.dtype == k.dtype
         assert np.array_equal(v2, v)
         assert pack_block(t2, k2, v2) == buf  # the identity, re-packed
-        assert KV_WIRE_VERSION == 1
+        assert KV_WIRE_VERSION == 2
         # bfloat16 — the model's flagship dtype — must round-trip too:
         # numpy's .str tag for it is an opaque void ('<V2'), so the
         # format carries the dtype NAME (review regression: promotion
@@ -1528,12 +1528,31 @@ class TestKVTier:
         assert np.array_equal(kb2.view(np.uint16),
                               np.asarray(kb).view(np.uint16))
         assert jnp.asarray(kb2).dtype == jnp.bfloat16  # promotion path
+        # magic/version rejection requires an INTACT buffer: the v2 crc
+        # is checked before any header field, so tampered headers must
+        # be re-sealed to reach the magic/version checks at all
+        import struct as _struct
+        import zlib as _zlib
+
+        def reseal(b: bytes) -> bytes:
+            return b[:-4] + _struct.pack(
+                "<I", _zlib.crc32(b[:-4]) & 0xFFFFFFFF)
+
         with pytest.raises(ValueError, match="magic"):
-            unpack_block(b"XXXX" + buf[4:])
+            unpack_block(reseal(b"XXXX" + buf[4:]))
         with pytest.raises(ValueError, match="version"):
-            unpack_block(buf[:4] + b"\x63\x00" + buf[6:])
+            unpack_block(reseal(buf[:4] + b"\x63\x00" + buf[6:]))
         with pytest.raises(ValueError, match="truncated"):
             unpack_block(buf[:10])
+        # v2 integrity: any single flipped byte — header, tokens, slab,
+        # or the trailer itself — is a typed WireCorruption, loudly
+        # distinct from honest foreign bytes
+        from kubeshare_tpu.serving.kv_tier import _HEADER, WireCorruption
+        for at in (0, 5, _HEADER.size + 1, len(buf) // 2, len(buf) - 1):
+            bad = bytearray(buf)
+            bad[at] ^= 0x40
+            with pytest.raises(WireCorruption):
+                unpack_block(bytes(bad))
 
     def test_demote_promote_roundtrip_is_byte_identical(self):
         """Device rows -> host payload -> device rows, bit for bit:
