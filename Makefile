@@ -12,6 +12,7 @@
 #   make serve-disagg-smoke disaggregated prefill/decode bench, fast CPU path
 #   make serve-sharded-smoke tensor-parallel sharded serving bench, fast CPU path
 #   make serve-loop-smoke   device-resident multi-step loop bench, fast CPU path
+#   make serve-loop-v2-smoke  verify-in-loop + admission ring bench, fast CPU path
 #   make serve-fleet-smoke  replica-fleet routing bench, fast CPU path
 #   make serve-autotune-smoke  cost-model autotuner bench, fast CPU path
 #   make serve-chaos-smoke  fault-injection fleet recovery bench, fast CPU path
@@ -22,7 +23,7 @@
 IMAGE ?= kubeshare-tpu:latest
 DOCKER ?= $(shell command -v docker || command -v podman)
 
-.PHONY: all native test serve-smoke serve-prefix-smoke serve-qos-smoke serve-mixed-smoke serve-tier-smoke serve-spec-smoke serve-disagg-smoke serve-sharded-smoke serve-loop-smoke serve-fleet-smoke serve-autotune-smoke serve-chaos-smoke images image-check e2e-kind tsan clean
+.PHONY: all native test serve-smoke serve-prefix-smoke serve-qos-smoke serve-mixed-smoke serve-tier-smoke serve-spec-smoke serve-disagg-smoke serve-sharded-smoke serve-loop-smoke serve-loop-v2-smoke serve-fleet-smoke serve-autotune-smoke serve-chaos-smoke images image-check e2e-kind tsan clean
 
 all: native
 
@@ -61,6 +62,9 @@ serve-sharded-smoke:
 
 serve-loop-smoke:
 	JAX_PLATFORMS=cpu python3 benchmarks/serving_bench.py --device-loop --smoke
+
+serve-loop-v2-smoke:
+	JAX_PLATFORMS=cpu python3 benchmarks/serving_bench.py --device-loop --speculative --smoke
 
 serve-fleet-smoke:
 	JAX_PLATFORMS=cpu python3 benchmarks/serving_bench.py --fleet --smoke

@@ -549,6 +549,49 @@ def loop_settings() -> dict:
     )
 
 
+def loop_spec_smoke_settings() -> dict:
+    """Seconds-fast verify-in-loop path (CI, make serve-loop-v2-smoke):
+    the echoed phrase-pool trace — speculative AND decode-heavy, the
+    traffic whose per-verify-span planner bill the v2 loop folds into
+    one launch — on the 1-layer smoke model.  decode_span 1 keeps the
+    undrafted-loop unit one forward pass; the smokes lock mechanics
+    (streams bit-exact across v2/v1/K=1, zero recompiles, the spec
+    loop actually firing), not wall-clock ratios."""
+    return dict(
+        d_model=128, n_layers=1, n_heads=4, n_kv_heads=2, d_ff=256,
+        vocab_size=512, max_seq_len=256,
+        num_requests=12,
+        num_slots=4, block_size=8, num_blocks=121,
+        max_request_len=224, prefill_chunk=16, decode_span=1,
+        draft_len=4, steps_per_launch=4, admission_ring=2,
+        num_phrases=4, phrase_len=6, phrases_per_prompt=3,
+        prompt_reps=2, echo_len=24, new_lo=48, new_hi=80,
+        mean_interarrival_s=0.0, seed=0,   # closed loop (see spec)
+    )
+
+
+def loop_spec_settings() -> dict:
+    """The verify-in-loop capture configuration (acceptance shape):
+    the full-bench model on the echoed phrase-pool trace at K=8 with a
+    3-deep admission ring — speculative decode-heavy traffic where the
+    v1 loop pays one planner invocation per verify span (every drafted
+    round exits the device) and v2 pays one per K-unit launch.  The
+    criterion: host planner invocations per emitted token >= 2x lower
+    than the v1 loop, realized fusion depth read off the metrics
+    plane, every stream bit-exact across all arms."""
+    return dict(
+        d_model=256, n_layers=4, n_heads=8, n_kv_heads=2, d_ff=1024,
+        vocab_size=4096, max_seq_len=320,
+        num_requests=32,
+        num_slots=6, block_size=16, num_blocks=161,
+        max_request_len=288, prefill_chunk=64, decode_span=1,
+        draft_len=8, steps_per_launch=8, admission_ring=3,
+        num_phrases=6, phrase_len=8, phrases_per_prompt=3,
+        prompt_reps=2, echo_len=32, new_lo=96, new_hi=160,
+        mean_interarrival_s=0.0, seed=0,   # closed loop (see spec)
+    )
+
+
 def autotune_smoke_settings() -> dict:
     """Seconds-fast autotuner path (CI, make serve-autotune-smoke): a
     three-phase shifting trace (decode-heavy -> prefill-heavy ->
@@ -1018,7 +1061,9 @@ def run_continuous(params, config, s: dict, trace,
                    long_context_threshold=None,
                    steps_per_launch: int = 1,
                    mixed_prefill_budget=None,
-                   autotune: bool = False) -> dict:
+                   autotune: bool = False,
+                   admission_ring: int = 0,
+                   spec_loop: bool = True) -> dict:
     from kubeshare_tpu.serving import EngineConfig, Request, ServingEngine
 
     mesh_spec = None
@@ -1040,8 +1085,16 @@ def run_continuous(params, config, s: dict, trace,
         long_context_threshold=long_context_threshold,
         steps_per_launch=steps_per_launch,
         autotune=autotune,
-        autotune_interval=s.get("autotune_interval", 32)),
+        autotune_interval=s.get("autotune_interval", 32),
+        admission_ring=admission_ring),
         tenants=registry)
+    if not spec_loop:
+        # v1-loop reference arm (the loop-v2 suite's bracket): disarm
+        # the speculative loop programs before warmup, so drafted
+        # rounds leave the device for a standalone verify span and
+        # only undrafted rounds take the plain device loop — the
+        # per-span planner bill verify-in-loop exists to cut
+        engine._spec_loops = {}
     engine.warmup()
     compiles_before = engine.compile_counts()
 
@@ -1114,6 +1167,25 @@ def run_continuous(params, config, s: dict, trace,
             metric, "kubeshare_serving_dispatches_total", kind="loop")),
         "loop_units": int(_metric_value(
             metric, "kubeshare_serving_loop_units_total")),
+        # device residency v2: speculative (verify-in-loop) launches
+        # and their draft-verify units, loop exits by reason, and the
+        # realized-fusion-depth summary — all read off the scrape
+        # surface, never private engine state
+        "spec_loop_launches": int(_metric_value(
+            metric, "kubeshare_serving_dispatches_total",
+            kind="spec_loop")),
+        "spec_loop_units": int(_metric_value(
+            metric, "kubeshare_serving_spec_loop_units_total")),
+        "loop_exit_reasons": {
+            dict(labels)["reason"]: int(v)
+            for (name, labels), v in metric.items()
+            if name == "kubeshare_serving_loop_exit_reason_total"},
+        "loop_realized_depth": {
+            "sum": float(_metric_value(
+                metric, "kubeshare_serving_loop_realized_depth_sum")),
+            "count": int(_metric_value(
+                metric,
+                "kubeshare_serving_loop_realized_depth_count"))},
         "planner_invocations": int(_metric_value(
             metric, "kubeshare_serving_host_planner_invocations_total")),
         "planner_per_token": _metric_value(
@@ -1971,10 +2043,135 @@ def run_loop_bench(s: dict, aba: bool = True) -> dict:
             off_planner / max(1, on["planner_invocations"]),
         "host_seconds_ratio": off_host / max(1e-9, on_host),
         "tokens_per_s_ratio": on["tokens_per_s"] / max(1e-9, off_tps),
-        # units per launch actually realized (early exits pull it
-        # under K; a decode-heavy trace should sit near K)
+        # units per launch actually realized, read off the metrics
+        # plane's summary family (early exits pull it under K; a
+        # decode-heavy trace should sit near K)
         "realized_fusion_depth":
-            on["loop_units"] / max(1, on["loop_launches"]),
+            on["loop_realized_depth"]["sum"]
+            / max(1, on["loop_realized_depth"]["count"]),
+        "streams_bit_exact": True,
+        "recompiles_after_warmup": recompiles,
+        "platform": jax.default_backend(),
+    }
+
+
+def run_loop_spec_bench(s: dict, aba: bool = True) -> dict:
+    """Verify-in-loop (device residency v2) vs the v1 device loop vs
+    K=1, all three arms speculating on one echoed phrase-pool trace at
+    the same engine geometry and KV-HBM budget.  The v1 arm runs the
+    SAME engine with the speculative loop programs disarmed — every
+    drafted round exits the device for a standalone verify span, the
+    per-span planner bill the verify-in-loop fold exists to cut — so
+    the headline ratio isolates exactly the fold.  The acceptance bar
+    (full settings): host planner invocations per emitted token >= 2x
+    lower than the v1 loop, realized fusion depth read off the metrics
+    plane's summary family, every stream bit-exact across all arms
+    (in-loop verification is exact-match against the engine's own pick
+    policy — draft content only moves the acceptance RATE), zero
+    recompiles after warmup everywhere.  ``aba=False`` drops the
+    second bracketing v1 run (tests lock mechanics, not timing)."""
+    config, params = _bench_model(s)
+    trace = echo_spec_trace(params, config, s, build_spec_workload(s))
+    k = s["steps_per_launch"]
+
+    # ABA bracket: host_seconds is a WALL metric, so the v2 run is
+    # bracketed by two v1-loop runs and compared to their mean;
+    # planner-invocation counts are deterministic.  The trailing K=1
+    # arm pins the no-loop oracle streams.
+    v1_a = run_continuous(params, config, s, trace, speculative=True,
+                          steps_per_launch=k, spec_loop=False)
+    v2 = run_continuous(params, config, s, trace, speculative=True,
+                        steps_per_launch=k,
+                        admission_ring=s["admission_ring"])
+    v1_b = (run_continuous(params, config, s, trace, speculative=True,
+                           steps_per_launch=k, spec_loop=False)
+            if aba else v1_a)
+    flat = run_continuous(params, config, s, trace, speculative=True)
+    recompiles = (v2.pop("recompiles") + v1_a.pop("recompiles")
+                  + (v1_b.pop("recompiles") if aba else 0)
+                  + flat.pop("recompiles"))
+    if recompiles:
+        raise RuntimeError(
+            f"{recompiles} recompilations after warmup — a static-shape "
+            f"leak; the comparison (and a TPU serving pod) is invalid")
+    # the tentpole's correctness half, end to end: folding draft +
+    # verify + acceptance + ring admission into one resident launch
+    # may not change a single token vs the v1 loop OR the K=1 engine
+    arms = {"v1_loop": v1_a, "k1": flat}
+    if aba:
+        arms["v1_loop_last"] = v1_b
+    mismatched = [
+        (name, rid) for name, arm in arms.items()
+        for rid in v2["requests"]
+        if v2["requests"][rid]["tokens"] != arm["requests"][rid]["tokens"]]
+    if mismatched:
+        raise RuntimeError(
+            f"streams diverged vs the verify-in-loop arm for "
+            f"{mismatched} — the speculative device loop is NOT "
+            f"bit-exact")
+    if v2["spec_loop_launches"] == 0:
+        raise RuntimeError(
+            "the speculative device loop never fired — the trace is "
+            "not draftable enough to measure anything")
+    v2.pop("requests")
+    for arm in arms.values():
+        arm.pop("requests", None)
+    useful = v2["useful_tokens"]
+    v1_planner = (v1_a["planner_invocations"]
+                  + v1_b["planner_invocations"]) / 2
+    v1_host = (sum(v1_a["host_seconds"].values())
+               + sum(v1_b["host_seconds"].values())) / 2
+    v2_host = sum(v2["host_seconds"].values())
+    flat_host = sum(flat["host_seconds"].values())
+    v1_tps = (v1_a["tokens_per_s"] + v1_b["tokens_per_s"]) / 2
+    drafted = sum(v2["spec_drafted"].values())
+    accepted = sum(v2["spec_accepted"].values())
+    depth = v2["loop_realized_depth"]
+    return {
+        "suite": "serving-loop-v2",
+        "metric": "host planner invocations per emitted token, "
+                  "verify-in-loop (spec loop + admission ring) over "
+                  "the v1 device loop (drafted rounds verify outside "
+                  "the loop) — same echoed phrase-pool closed-loop "
+                  "trace, same engine geometry and KV-HBM budget; "
+                  "planner, host-seconds, exit reasons and realized "
+                  "depth all read through the metrics plane; v1 = "
+                  "mean of the two bracketing runs; a K=1 arm pins "
+                  "the no-loop oracle streams",
+        "settings": {key: v for key, v in s.items()},
+        "steps_per_launch": k,
+        "admission_ring": s["admission_ring"],
+        "loop_v2": v2,
+        "loop_v1_first": v1_a,
+        "loop_v1_last": v1_b,
+        "unlooped": flat,
+        "loop_v1": {"tokens_per_s": v1_tps,
+                    "planner_invocations": v1_planner,
+                    "planner_per_token": (v1_a["planner_per_token"]
+                                          + v1_b["planner_per_token"])
+                    / 2,
+                    "host_seconds_total": v1_host},
+        "planner_invocations_ratio_vs_v1":
+            v1_planner / max(1, v2["planner_invocations"]),
+        "planner_invocations_ratio_vs_k1":
+            flat["planner_invocations"]
+            / max(1, v2["planner_invocations"]),
+        "host_seconds_per_token": {
+            "v2": v2_host / max(1, useful),
+            "v1": v1_host / max(1, useful),
+            "k1": flat_host / max(1, useful)},
+        "host_seconds_ratio_vs_v1": v1_host / max(1e-9, v2_host),
+        "tokens_per_s_ratio_vs_v1":
+            v2["tokens_per_s"] / max(1e-9, v1_tps),
+        # realized depth straight off the metrics plane's summary
+        # family (both loop kinds; redraft/retire exits pull it
+        # under K, ring refills push launches back toward it)
+        "realized_fusion_depth":
+            depth["sum"] / max(1, depth["count"]),
+        "loop_exit_reasons": v2["loop_exit_reasons"],
+        "draft_acceptance_rate": accepted / max(1, drafted),
+        "drafted_tokens": drafted,
+        "accepted_tokens": accepted,
         "streams_bit_exact": True,
         "recompiles_after_warmup": recompiles,
         "platform": jax.default_backend(),
@@ -2586,7 +2783,10 @@ def main() -> None:
                              "(steps_per_launch=K) vs K=1 on a "
                              "decode-heavy trace (streams hard-asserted "
                              "identical; planner-invocations-per-token "
-                             "headline)")
+                             "headline); combine with --speculative "
+                             "for the verify-in-loop + admission-ring "
+                             "suite (v2 vs v1 loop vs K=1 on an echoed "
+                             "phrase-pool trace)")
     parser.add_argument("--fleet", action="store_true",
                         help="replica fleet: prefix-affinity routing vs "
                              "round-robin at equal aggregate KV budget "
@@ -2638,6 +2838,10 @@ def main() -> None:
     elif args.disagg:
         result = run_disagg_bench(
             disagg_smoke_settings() if args.smoke else disagg_settings())
+    elif args.device_loop and args.speculative:
+        result = run_loop_spec_bench(
+            loop_spec_smoke_settings() if args.smoke
+            else loop_spec_settings())
     elif args.speculative:
         result = run_speculative_bench(
             spec_smoke_settings() if args.smoke else spec_settings())
@@ -2752,6 +2956,31 @@ def main() -> None:
               f"{on['decode_steps']} decode spans vs "
               f"{off['mixed_steps']} fused monolithic dispatches; "
               f"streams bit-exact", file=sys.stderr)
+        return
+    if args.device_loop and args.speculative:
+        v2 = result["loop_v2"]
+        k = result["steps_per_launch"]
+        hspt = result["host_seconds_per_token"]
+        exits = {r: n for r, n in
+                 sorted(result["loop_exit_reasons"].items()) if n}
+        print(f"\nverify-in-loop device loop (K={k}, admission ring "
+              f"{result['admission_ring']}): planner invocations/token "
+              f"{v2['planner_per_token']:.3f} vs "
+              f"{result['loop_v1']['planner_per_token']:.3f} v1-loop "
+              f"({result['planner_invocations_ratio_vs_v1']:.2f}x "
+              f"fewer, target >= 2x on the full workload; "
+              f"{result['planner_invocations_ratio_vs_k1']:.2f}x vs "
+              f"K=1); host s/token {hspt['v2']:.2e} vs "
+              f"{hspt['v1']:.2e} v1 "
+              f"({result['host_seconds_ratio_vs_v1']:.2f}x lower); "
+              f"realized fusion depth "
+              f"{result['realized_fusion_depth']:.1f}/{k} (metrics "
+              f"plane); {v2['spec_loop_launches']} spec-loop launches, "
+              f"exits {exits}; draft acceptance "
+              f"{100 * result['draft_acceptance_rate']:.1f}%; tokens/s "
+              f"ratio {result['tokens_per_s_ratio_vs_v1']:.3f} vs v1; "
+              f"streams bit-exact across v2/v1/K=1; zero recompiles",
+              file=sys.stderr)
         return
     if args.speculative:
         on = result["speculative"]

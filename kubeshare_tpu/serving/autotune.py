@@ -120,7 +120,8 @@ class CostModel:
     # both phases' work; a verify chunk is a decode step plus k extra
     # scored columns)
     DEFAULT_COSTS = {"prefill": 1.0, "decode": 1.0, "mixed": 1.4,
-                     "verify": 1.2, "mixed_verify": 1.6, "loop": 1.0}
+                     "verify": 1.2, "mixed_verify": 1.6, "loop": 1.0,
+                     "spec_loop": 1.2}
 
     def __init__(self, max_rows: int = 64) -> None:
         self.max_rows = max_rows
@@ -264,12 +265,16 @@ class AnalyticPolicy(TuningPolicy):
         view = knobs.get("steps_per_launch")
         if view is not None:
             k = view.value
-            launches = get("loop_launches", 0.0)
-            units = get("loop_units", 0.0)
+            launches = (get("loop_launches", 0.0)
+                        + get("spec_loop_launches", 0.0))
+            units = (get("loop_units", 0.0)
+                     + get("spec_loop_units", 0.0))
             standalone_decode = (get("decode_steps", 0.0)
-                                 - get("mixed_steps", 0.0) - units)
+                                 - get("mixed_steps", 0.0)
+                                 - get("loop_units", 0.0))
             other = (get("prefill_chunks", 0.0) + get("verify_steps", 0.0)
-                     + get("mixed_steps", 0.0))
+                     + get("mixed_steps", 0.0)
+                     - get("spec_loop_units", 0.0))
             nxt = k
             if launches > 0:
                 depth = units / launches
@@ -292,6 +297,19 @@ class AnalyticPolicy(TuningPolicy):
                     accepted / drafted, view.spec.values)
                 if best != view.value:
                     out["draft_width_cap"] = best
+
+        view = knobs.get("loop_draft_width")
+        if view is not None:
+            drafted = get("spec_drafted", 0.0)
+            accepted = get("spec_accepted", 0.0)
+            if drafted >= self.min_drafted:
+                # the in-loop draft cap shares the verify-width economics
+                # of the host cap, but every unit is launch-covered: the
+                # argmax is the same expected-tokens-per-dispatch rule
+                best = cost_model.best_draft_width(
+                    accepted / drafted, view.spec.values)
+                if best != view.value:
+                    out["loop_draft_width"] = best
 
         view = knobs.get("decode_priority")
         if view is not None:
@@ -404,9 +422,11 @@ class AutoTuner:
             "decode": g("decode_steps", 0.0) - g("mixed_steps", 0.0)
             - g("loop_units", 0.0),
             "mixed": g("mixed_steps", 0.0),
-            "verify": g("verify_steps", 0.0) - g("mixed_verify_steps", 0.0),
+            "verify": g("verify_steps", 0.0) - g("mixed_verify_steps", 0.0)
+            - g("spec_loop_units", 0.0),
             "mixed_verify": g("mixed_verify_steps", 0.0),
             "loop": g("loop_launches", 0.0),
+            "spec_loop": g("spec_loop_launches", 0.0),
         }
 
     def tick(self) -> bool:
@@ -468,8 +488,9 @@ class AutoTuner:
                    interval: int = 32) -> "AutoTuner":
         """Tuner over one engine's recompile-free knobs: the fused
         budget (warmed chunk universe), the effective loop depth
-        (warmed loop-K set, 1 = loop disarmed), and the draft-width cap
-        (warmed verify widths)."""
+        (warmed loop-K set, 1 = loop disarmed), the draft-width cap
+        (warmed verify widths), and — on a verify-in-loop engine — the
+        in-loop draft width (data inside the warmed loop program)."""
         ec = engine.engine_config
         knobs: List[Knob] = []
         if ec.mixed and engine._warmed_widths:
@@ -493,6 +514,18 @@ class AutoTuner:
                 KnobSpec("draft_width_cap", values=tuple(caps)),
                 get=lambda: engine._draft_width_cap,
                 set=lambda v: setattr(engine, "_draft_width_cap", v)))
+        if getattr(engine, "_spec_loops", None):
+            # the verify-in-loop draft cap: in-loop lane draft widths are
+            # data (the loop pads to the warmed verify width), so any
+            # power-of-two <= draft_len is recompile-free by construction
+            caps, w = [], 1
+            while w <= ec.draft_len:
+                caps.append(w)
+                w *= 2
+            knobs.append(Knob(
+                KnobSpec("loop_draft_width", values=tuple(caps)),
+                get=lambda: engine._loop_draft_cap,
+                set=lambda v: setattr(engine, "_loop_draft_cap", v)))
 
         def read():
             counters = {
@@ -503,6 +536,8 @@ class AutoTuner:
                 "mixed_verify_steps": engine.mixed_verify_steps,
                 "loop_launches": engine.loop_launches,
                 "loop_units": engine.loop_units,
+                "spec_loop_launches": engine.spec_loop_launches,
+                "spec_loop_units": engine.spec_loop_units,
                 "spec_drafted": sum(engine.spec_drafted.values()),
                 "spec_accepted": sum(engine.spec_accepted.values()),
                 "tokens_generated": engine.tokens_generated,
@@ -552,6 +587,9 @@ class AutoTuner:
                                        + d.mixed_verify_steps),
                 "loop_launches": p.loop_launches + d.loop_launches,
                 "loop_units": p.loop_units + d.loop_units,
+                "spec_loop_launches": (p.spec_loop_launches
+                                       + d.spec_loop_launches),
+                "spec_loop_units": p.spec_loop_units + d.spec_loop_units,
             }
             staged = sum(s.state != "free" for s in p._slots)
             gauges = {

@@ -41,6 +41,8 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence
 
+import jax.numpy as jnp
+
 
 class NGramDrafter:
     """One lane's drafting state: a token history window plus the
@@ -116,3 +118,58 @@ class NGramDrafter:
             if seq[i: i + order] == pattern:
                 return seq[i + order: i + order + k]
         return []
+
+
+def ngram_propose_rows(hist, hist_len, caps, max_order: int, width: int):
+    """Vectorized device-side mirror of :meth:`NGramDrafter.propose` —
+    the in-loop drafting path of ``paged.paged_spec_loop``.
+
+    ``hist`` [S, H] is every lane's RIGHT-ALIGNED token-history window
+    (newest token at column H-1; only the last ``hist_len[s]`` columns
+    are real), ``caps`` [S] the per-lane draft budget (the engine's
+    ``min(adaptive width, remaining budget - 1)`` arithmetic, computed
+    by the loop body as data).  Returns (draft [S, width], n_draft [S]):
+    up to ``width`` proposed tokens per lane, -1 past ``n_draft[s]``.
+
+    The selection rule is the host drafter's, order for order: longest
+    matching suffix wins across orders (``max_order`` down to 1), the
+    most recent earlier occurrence wins within an order, and the
+    window's own current suffix is never the match (candidate starts
+    stop ``order + 1`` short of the end, so at least one follower
+    exists).  Two deliberate differences from the host path, both
+    scheduling-only — verification is exact-match against the engine's
+    own pick policy, so draft CONTENT can never change a stream, only
+    the acceptance rate: (1) the window is the bounded on-device ring,
+    not the unbounded host history; (2) there is no secondary hint
+    window (the trie lives on the host).
+    """
+    s, h = hist.shape
+    draft = jnp.full((s, width), -1, jnp.int32)
+    n_draft = jnp.zeros((s,), jnp.int32)
+    col = jnp.arange(width, dtype=jnp.int32)[None, :]
+    for order in range(max_order, 0, -1):
+        if h < order + 2:
+            continue
+        # candidate starts p in [0, h-order-1]: window [p, p+order) with
+        # follower p+order <= h-1; the suffix's own start h-order is
+        # excluded by construction (no follower would exist)
+        starts = jnp.arange(h - order, dtype=jnp.int32)
+        windows = jnp.stack(
+            [hist[:, j: j + h - order] for j in range(order)], axis=-1)
+        pattern = hist[:, h - order:]  # [S, order]
+        match = jnp.all(windows == pattern[:, None, :], axis=-1)
+        # the whole candidate window must sit inside the lane's real
+        # (right-aligned) history — this also implies hist_len >= order+1
+        match = match & (starts[None, :] >= (h - hist_len)[:, None])
+        best = jnp.max(jnp.where(match, starts[None, :], -1), axis=1)
+        found = best >= 0
+        fstart = jnp.maximum(best, 0) + order  # first follower column
+        n = jnp.minimum(jnp.minimum(h - fstart, caps), width)
+        n = jnp.where(found, jnp.maximum(n, 0), 0)
+        idx = jnp.clip(fstart[:, None] + col, 0, h - 1)
+        cand = jnp.take_along_axis(hist, idx, axis=1)
+        cand = jnp.where(col < n[:, None], cand, -1)
+        use = (n_draft == 0) & (n > 0)
+        draft = jnp.where(use[:, None], cand, draft)
+        n_draft = jnp.where(use, n, n_draft)
+    return draft, n_draft

@@ -118,7 +118,8 @@ from .kv_tier import (HostTier, LRUTierPolicy, QoSTierPolicy,
 from .paged import (paged_copy_block, paged_decode_loop,
                     paged_decode_span, paged_mixed_step,
                     paged_mixed_verify_step, paged_prefill_step,
-                    paged_upload_block, paged_verify_span)
+                    paged_spec_loop, paged_upload_block,
+                    paged_verify_span)
 from .prefix_index import PrefixIndex
 from .sharded import ShardedServingContext
 from .qos import (DEFAULT_TENANT, QOS_GUARANTEE, QOS_OPPORTUNISTIC,
@@ -138,6 +139,20 @@ TBT_BUCKETS = (0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
 # accepted drafts / drafted — always in [0, 1], so the +Inf tail stays
 # structurally empty and the top bucket counts full-accept rounds.
 SPEC_ACCEPT_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+# Speculative device-loop statics (device residency v2).  The on-device
+# drafting window: each lane carries its most recent SPEC_LOOP_HIST
+# emitted tokens as right-aligned loop state, the device n-gram
+# proposer's lookup universe (drafts are scheduling-only — verification
+# is exact-match against the engine's own picks, so a bounded window
+# changes acceptance RATE, never streams).  The re-draft threshold: a
+# unit whose drafting lanes accept below this fraction of their
+# AGGREGATE proposals exits the loop at that span boundary — the
+# host's adaptive width controller (EMA halving) gets to observe the
+# collapse instead of the device grinding K units of misses, while a
+# single cold lane cannot end the launch for the whole batch.
+SPEC_LOOP_HIST = 64
+SPEC_LOOP_REDRAFT = 0.25
 
 
 def _pow2_ceil(n: int) -> int:
@@ -340,6 +355,17 @@ class EngineConfig:
     # TuningPolicy is sandboxed to the warmed-shape envelope.
     autotune: bool = False
     autotune_interval: int = 32
+    # PENDING-LANE ADMISSION RING (device residency v2): the number of
+    # queued requests the engine pre-admits and pre-prefills ahead of a
+    # speculative device-loop launch.  The ring rides into the launch as
+    # pre-marshaled lane state (block table, budget, PRNG key schedule,
+    # drafting window); when a lane retires at a span boundary INSIDE
+    # the loop, the device activates the next ring entry in place — an
+    # admission costs a ring write instead of a loop exit + replan +
+    # relaunch.  0 = off (a retirement ends the launch).  Requires
+    # speculative=True, steps_per_launch > 1, and pool_role="both"
+    # (the host-side fill runs this pool's own prefill path).
+    admission_ring: int = 0
 
 
 def _warmed_prefill_widths(ec: EngineConfig) -> set:
@@ -446,6 +472,16 @@ def _config_rows(ec: EngineConfig, config: TransformerConfig,
          f"autotune_interval must be >= 1, got "
          f"{ec.autotune_interval} — the tuner ticks once per "
          f"scheduler step and retunes every interval-th tick"),
+        (ec.admission_ring < 0,
+         f"admission_ring must be >= 0, got {ec.admission_ring}"),
+        (ec.admission_ring > 0 and (not ec.speculative
+                                    or ec.steps_per_launch <= 1
+                                    or ec.pool_role != "both"),
+         f"admission_ring {ec.admission_ring} requires "
+         f"speculative=True, steps_per_launch > 1 and "
+         f"pool_role='both' — the ring is consumed only inside the "
+         f"speculative device loop, and its host-side fill runs this "
+         f"pool's own prefill path"),
     ]
 
 
@@ -713,6 +749,19 @@ class ServingEngine:
         # (starts uncapped at draft_len)
         self._loop_k = ec.steps_per_launch
         self._draft_width_cap = ec.draft_len
+        # ...and the IN-LOOP draft-width cap (the spec loop's twin of
+        # _draft_width_cap): bounds the device drafter's per-unit
+        # proposal width inside a speculative launch.  Per-lane widths
+        # are DATA to the one compiled spec-loop shape, so the tuner
+        # moves this recompile-free.
+        self._loop_draft_cap = ec.draft_len
+        # pending-lane admission ring (device residency v2): requests
+        # fully admitted and prefilled host-side, staged in detached
+        # _Slot objects (idx -1) for in-loop activation.  The loop
+        # binds one to a lane when that lane retires at a span
+        # boundary; entries the loop never activated are bound to free
+        # engine slots by _admit on the next step.
+        self._ring_staged: List[_Slot] = []
         # admission queue: the QoS fair queue over _Pending entries
         # (plan + block count computed once at submit; _admit re-plans
         # only on a prefix-cache hit).  The default registry holds one
@@ -763,6 +812,25 @@ class ServingEngine:
         # average — exactly the amortization the loop exists to buy)
         self.loop_launches = 0
         self.loop_units = 0
+        # device residency v2 counters: speculative (verify-in-loop)
+        # launches and the draft-verify units they ran (each unit is
+        # one in-loop draft + width-W verify + acceptance round,
+        # absorbed into verify_steps the way loop_units absorb into
+        # decode_steps); loop exits by reason; and a realized-fusion-
+        # depth summary (units per launch, BOTH loop kinds) so the
+        # bench reads depth straight off the metrics plane instead of
+        # dividing counters
+        self.spec_loop_launches = 0
+        self.spec_loop_units = 0
+        self.loop_exit_reasons: Dict[str, int] = {
+            "retire": 0, "budget": 0, "stop": 0, "redraft": 0,
+            "ring_empty": 0}
+        self.loop_depth_sum = 0
+        self.loop_depth_count = 0
+        # span-units covered by the most recent launch — the fleet's
+        # dispatch watchdog scales its hang budget by this so a healthy
+        # K-unit launch is never flagged hung
+        self.last_launch_units = 1
         # host-overhead observability (the device loop's proof plane):
         # wall seconds per scheduling phase of step(), and the number
         # of planner invocations — the numerator and denominator the
@@ -923,6 +991,42 @@ class ServingEngine:
                         if k <= ec.steps_per_launch] if ec.autotune
                        else [ec.steps_per_launch])
         self._loop_steps = {k: make_loop(k) for k in loop_ks}
+
+        max_order = ec.draft_ngram
+        spec_w = 1 + ec.draft_len
+
+        def make_spec_loop(k_units):
+            # device residency v2: the SPECULATIVE device loop — each
+            # unit drafts on device (n-gram suffix match over the
+            # lane's token-history window), runs the width-W verify,
+            # and applies acceptance without leaving the device; ring
+            # admissions activate pre-marshaled pending lanes at span
+            # boundaries.  One shape per depth, like make_loop.
+            def spec_loop(w, pk, pv, tables, lengths, active, tokens,
+                          temps, keys, budgets, hist, hist_len, dcaps,
+                          r_tables, r_lengths, r_tokens, r_temps,
+                          r_keys, r_budgets, r_hist, r_hist_len,
+                          r_caps, r_count):
+                return paged_spec_loop(
+                    w, cfg, pick_rows, k_units, eos, max_order,
+                    SPEC_LOOP_REDRAFT, spec_w, pk, pv, tables,
+                    lengths, active, tokens, temps, keys, budgets,
+                    hist, hist_len, dcaps, r_tables, r_lengths,
+                    r_tokens, r_temps, r_keys, r_budgets, r_hist,
+                    r_hist_len, r_caps, r_count)
+
+            if sharded is not None:
+                spec_loop = sharded.spec_loop(
+                    pick_rows, k_units, eos, max_order,
+                    SPEC_LOOP_REDRAFT, spec_w)
+            return jax.jit(spec_loop, donate_argnums=(1, 2))
+
+        # one speculative loop program per warmed depth — exactly the
+        # plain loop's depth set, armed only when speculation is on
+        # and this pool runs decode plans at all
+        self._spec_loops = (
+            {k: make_spec_loop(k) for k in loop_ks}
+            if ec.speculative and ec.pool_role != "prefill" else {})
 
         def mixed(w, pk, pv, p_table, p_start, p_tokens, p_last_row,
                   p_temp, p_key, d_tables, d_lengths, d_active,
@@ -1296,23 +1400,28 @@ class ServingEngine:
         to ``decode_span`` per dispatch), so the plan falls back to
         it.
 
-        The device loop (``steps_per_launch > 1``) fires only on the
-        pure-decode fallback of a NON-fused step: a mixed step carries
-        per-chunk prefill host work and a verify round needs per-round
-        host drafting, so neither can run headless for K units.  Under
-        speculation the loop therefore batches only no-draft rounds —
-        it may skip the re-draft checks a K=1 engine would have made
-        between those rounds, which changes SCHEDULING (fewer verify
-        opportunities) but never streams (verification is exact-match
-        against the engine's own picks, so every schedule emits the
-        identical tokens).  The launch ENVELOPE is this plan: which
-        lanes, span width, and up to K units; the dispatcher runs the
-        fused program and the device decides how many units actually
+        The device loop (``steps_per_launch > 1``) fires on any
+        NON-fused decode-phase step (a mixed step carries per-chunk
+        prefill host work and cannot run headless for K units).  A
+        DRAFTED round rides the SPECULATIVE loop (device residency
+        v2): the host draft is only the arming signal — some lane has
+        a continuation worth verifying — and the device re-drafts
+        every unit, the first included, from its own on-device history
+        window, so draft CONTENT stays scheduling-only and streams
+        stay bit-exact (verification is exact-match against the
+        engine's own picks, so every draft schedule emits the
+        identical tokens).  A no-draft round rides the plain decode
+        loop.  The launch ENVELOPE is this plan: which lanes, span
+        width, and up to K units; the dispatcher runs the fused
+        program and the device decides how many units actually
         execute."""
         ec = self.engine_config
         if ec.speculative:
             drafts = self._plan_drafts(decode)
             if drafts:
+                if self._loop_k > 1 and not fused and self._spec_loops:
+                    return _StepPlan("spec_loop", decode_slots=decode,
+                                     drafts=drafts)
                 width = 1 + _pow2_ceil(
                     max(len(d) for d in drafts.values()))
                 return _StepPlan("verify", decode_slots=decode,
@@ -1342,6 +1451,12 @@ class ServingEngine:
         """Launch one planned step — device-argument marshaling and
         dispatch only; every scheduling decision was made in
         :meth:`_plan_step`."""
+        # the fleet watchdog's hang budget scales by the units this
+        # launch may legitimately cover — a deep loop is slower than a
+        # span WITHOUT being hung
+        self.last_launch_units = (self._loop_k
+                                  if plan.kind in ("loop", "spec_loop")
+                                  else 1)
         if plan.kind == "mixed":
             self._run_mixed_step(plan.decode_slots, plan.prefill_slot,
                                  plan.chunk)
@@ -1351,6 +1466,8 @@ class ServingEngine:
             self._run_prefill_chunk(plan.prefill_slot, plan.chunk)
         elif plan.kind == "verify":
             self._run_verify_step(plan)
+        elif plan.kind == "spec_loop":
+            self._run_spec_loop_step(plan)
         elif plan.kind == "loop":
             self._run_loop_step(plan.decode_slots)
         else:
@@ -1370,6 +1487,7 @@ class ServingEngine:
     @property
     def idle(self) -> bool:
         return (not self._queue and self._inflight is None
+                and not self._ring_staged
                 and all(s.state == "free" for s in self._slots))
 
     def result(self, rid: str) -> RequestResult:
@@ -1509,6 +1627,33 @@ class ServingEngine:
                           jnp.uint32),
                 zeros_s)
             self.pool = replace(self.pool, k=pk, v=pv)
+        for k_depth, spec_step in sorted(self._spec_loops.items()):
+            # the speculative loop's one shape per depth: all-inactive
+            # lanes exit at unit 0 exactly like the plain loop, and a
+            # ring count of 0 keeps the admit path dead.  The ring
+            # arrays' row count is the CONFIGURED admission_ring — a
+            # static part of the shape, zero rows when the ring is off.
+            w = 1 + ec.draft_len
+            r = ec.admission_ring
+            _, _, _, _, _, pk, pv = spec_step(
+                self.params, self.pool.k, self.pool.v,
+                jnp.zeros((s, self._table_width), jnp.int32),
+                zeros_s, jnp.zeros((s,), bool), zeros_s,
+                jnp.zeros((s,), jnp.float32),
+                jnp.zeros((s, k_depth * w, 2), jnp.uint32),
+                zeros_s, jnp.zeros((s, SPEC_LOOP_HIST), jnp.int32),
+                zeros_s, zeros_s,
+                jnp.zeros((r, self._table_width), jnp.int32),
+                jnp.zeros((r,), jnp.int32),
+                jnp.zeros((r,), jnp.int32),
+                jnp.zeros((r,), jnp.float32),
+                jnp.zeros((r, k_depth * w, 2), jnp.uint32),
+                jnp.zeros((r,), jnp.int32),
+                jnp.zeros((r, SPEC_LOOP_HIST), jnp.int32),
+                jnp.zeros((r,), jnp.int32),
+                jnp.zeros((r,), jnp.int32),
+                jnp.zeros((), jnp.int32))
+            self.pool = replace(self.pool, k=pk, v=pv)
         if ec.speculative and ec.pool_role != "prefill":
             # verify widths are 1 + pow2(max draft) with the adaptive
             # controller confined to power-of-two widths <= draft_len,
@@ -1557,6 +1702,8 @@ class ServingEngine:
             "upload": self._upload_step._cache_size(),
             "loop": sum(step._cache_size()
                         for step in self._loop_steps.values()),
+            "spec_loop": sum(step._cache_size()
+                             for step in self._spec_loops.values()),
         }
 
     # ------------------------------------------------------------------
@@ -1612,10 +1759,13 @@ class ServingEngine:
                        - self.loop_units)
         dispatches.add({"kind": "mixed", **plabel}, self.mixed_steps)
         dispatches.add({"kind": "verify_span", **plabel},
-                       self.verify_steps - self.mixed_verify_steps)
+                       self.verify_steps - self.mixed_verify_steps
+                       - self.spec_loop_units)
         dispatches.add({"kind": "mixed_verify", **plabel},
                        self.mixed_verify_steps)
         dispatches.add({"kind": "loop", **plabel}, self.loop_launches)
+        dispatches.add({"kind": "spec_loop", **plabel},
+                       self.spec_loop_launches)
         dispatches.add({"kind": "cow_copy", **plabel}, self.cow_copies)
         loop_units = MetricFamily(
             "kubeshare_serving_loop_units_total",
@@ -1624,6 +1774,36 @@ class ServingEngine:
             "fusion depth; at most steps_per_launch per launch).",
             "counter")
         loop_units.add(dict(plabel), self.loop_units)
+        spec_loop_units = MetricFamily(
+            "kubeshare_serving_spec_loop_units_total",
+            "Draft-verify units executed inside speculative device-"
+            "resident loop launches (each unit is one in-loop draft + "
+            "width-W verify + acceptance round, absorbed into "
+            "verify_steps).", "counter")
+        spec_loop_units.add(dict(plabel), self.spec_loop_units)
+        exit_reason = MetricFamily(
+            "kubeshare_serving_loop_exit_reason_total",
+            "Device-resident loop launches by exit reason (both loop "
+            "kinds): retire = a lane exhausted its budget unrefilled, "
+            "stop = a lane hit EOS unrefilled, budget = all K units "
+            "ran, redraft = in-loop acceptance collapsed below the "
+            "re-draft threshold, ring_empty = a lane died with the "
+            "admission ring configured but drained.", "counter")
+        for reason in sorted(self.loop_exit_reasons):
+            exit_reason.add({"reason": reason, **plabel},
+                            self.loop_exit_reasons[reason])
+        depth_summary = MetricFamily(
+            "kubeshare_serving_loop_realized_depth",
+            "Realized fusion depth per device-loop launch (span-units "
+            "actually executed, both loop kinds) — the direct summary "
+            "serving_bench reads instead of dividing counter "
+            "families.", "summary")
+        depth_summary.samples.append(Sample(
+            "kubeshare_serving_loop_realized_depth_sum", dict(plabel),
+            self.loop_depth_sum))
+        depth_summary.samples.append(Sample(
+            "kubeshare_serving_loop_realized_depth_count", dict(plabel),
+            self.loop_depth_count))
         host_s = MetricFamily(
             "kubeshare_serving_host_seconds_total",
             "Host wall seconds inside the engine's step loop, by "
@@ -1798,7 +1978,8 @@ class ServingEngine:
                     self._tuner.decisions.items()):
                 tuner.add({"knob": knob, "direction": direction,
                            **plabel}, n)
-        return [req, blocks, tokens, dispatches, loop_units, host_s,
+        return [req, blocks, tokens, dispatches, loop_units,
+                spec_loop_units, exit_reason, depth_summary, host_s,
                 planner, prefix, hit_tokens, evicted, tier_blocks,
                 tier_req, tier_tokens, tier_bytes, tier_stall,
                 tier_corrupt, ttft,
@@ -2026,6 +2207,22 @@ class ServingEngine:
         follows), then reserves only the blocks the uncached suffix
         needs.  A partially matched tail block is copied-on-write into
         the first fresh block before the slot may append to it."""
+        # device residency v2: ring-staged requests the loop did NOT
+        # activate (it exited first) enter through the normal slot path
+        # — each is already admitted and prefilled, so binding is a
+        # pure field copy into a free lane.  Guarded against a still-
+        # in-flight spec loop: its consume may yet activate these
+        # entries on device, and a host-side bind here would double-
+        # serve them.
+        if self._ring_staged and (self._inflight is None
+                                  or self._inflight[0] != "spec_loop"):
+            for staged in list(self._ring_staged):
+                slot = next((s for s in self._slots
+                             if s.state == "free"), None)
+                if slot is None:
+                    break
+                self._bind_staged(staged, slot)
+                self._ring_staged.remove(staged)
         while True:
             if self.admission_gate is not None \
                     and not self.admission_gate():
@@ -2074,6 +2271,18 @@ class ServingEngine:
                 return
             if not progressed:
                 return
+
+    def _bind_staged(self, staged: _Slot, slot: _Slot) -> None:
+        """Bind one ring-staged (admitted + prefilled) request into a
+        real engine lane: a pure field copy — every piece of engine-
+        global state (allocator charges, results map, counters, queue
+        service) was already mutated when the staged slot passed
+        :meth:`_try_admit` and its synchronous prefill."""
+        for name in _Slot.__slots__:
+            if name in ("idx", "table"):
+                continue
+            setattr(slot, name, getattr(staged, name))
+        slot.table[:] = staged.table
 
     def _quota_blocked(self, pending: _Pending, spec: TenantSpec) -> bool:
         """Would admitting ``pending`` fail on its tenant's OWN quota
@@ -2589,6 +2798,182 @@ class ServingEngine:
         self._inflight = ("loop", (ring, units, list(decode_slots),
                                    budgets), None)
 
+    def _spec_loop_lanes(self, decode_slots: List[_Slot],
+                         k_depth: int):
+        """Device arguments for a speculative loop launch: the decode-
+        lane marshal plus each lane's right-aligned on-device drafting
+        window and the FLAT key buffer K verify units consume (unit u
+        reads key indices ``done .. done+W-1`` where ``done`` is the
+        lane's in-loop emission count — exactly the indices K separate
+        verify dispatches would have consumed)."""
+        ec = self.engine_config
+        s = ec.num_slots
+        n_keys = k_depth * (1 + ec.draft_len)
+        tables = np.zeros((s, self._table_width), np.int32)
+        lengths = np.zeros((s,), np.int32)
+        active = np.zeros((s,), bool)
+        tokens = np.zeros((s,), np.int32)
+        temps = np.zeros((s,), np.float32)
+        keys = np.zeros((s, n_keys, 2), np.uint32)
+        budgets = np.zeros((s,), np.int32)
+        hist = np.zeros((s, SPEC_LOOP_HIST), np.int32)
+        hist_len = np.zeros((s,), np.int32)
+        dcaps = np.zeros((s,), np.int32)
+        for slot in decode_slots:
+            i = slot.idx
+            tables[i] = slot.table
+            lengths[i] = slot.length
+            active[i] = True
+            tokens[i] = slot.generated[-1]
+            temps[i] = slot.temperature
+            budgets[i] = slot.max_new - len(slot.generated)
+            if slot.temperature > 0.0:
+                offset = len(slot.generated) - 1
+                window = slot.step_keys[offset: offset + n_keys]
+                keys[i, : len(window)] = window
+            toks = (list(slot.prompt)
+                    + list(slot.generated))[-SPEC_LOOP_HIST:]
+            hist[i, SPEC_LOOP_HIST - len(toks):] = toks
+            hist_len[i] = len(toks)
+            dcaps[i] = min(slot.draft_width, self._loop_draft_cap)
+        return (tables, lengths, active, tokens, temps, keys, budgets,
+                hist, hist_len, dcaps)
+
+    def _ring_lanes(self, k_depth: int):
+        """Pre-marshaled pending-lane ring arrays from the staged
+        admissions (rows past the returned count are zero and never
+        read — the device guards activation on ``head < ring_count``).
+        Returns the arrays plus the staged slots they were built from,
+        in ring order."""
+        ec = self.engine_config
+        r = ec.admission_ring
+        n_keys = k_depth * (1 + ec.draft_len)
+        r_tables = np.zeros((r, self._table_width), np.int32)
+        r_lengths = np.zeros((r,), np.int32)
+        r_tokens = np.zeros((r,), np.int32)
+        r_temps = np.zeros((r,), np.float32)
+        r_keys = np.zeros((r, n_keys, 2), np.uint32)
+        r_budgets = np.zeros((r,), np.int32)
+        r_hist = np.zeros((r, SPEC_LOOP_HIST), np.int32)
+        r_hist_len = np.zeros((r,), np.int32)
+        r_caps = np.zeros((r,), np.int32)
+        staged = list(self._ring_staged[:r])
+        for j, slot in enumerate(staged):
+            r_tables[j] = slot.table
+            r_lengths[j] = slot.length
+            r_tokens[j] = slot.generated[-1]
+            r_temps[j] = slot.temperature
+            r_budgets[j] = slot.max_new - len(slot.generated)
+            if slot.temperature > 0.0:
+                offset = len(slot.generated) - 1
+                window = slot.step_keys[offset: offset + n_keys]
+                r_keys[j, : len(window)] = window
+            toks = (list(slot.prompt)
+                    + list(slot.generated))[-SPEC_LOOP_HIST:]
+            r_hist[j, SPEC_LOOP_HIST - len(toks):] = toks
+            r_hist_len[j] = len(toks)
+            r_caps[j] = min(slot.draft_width, self._loop_draft_cap)
+        return (r_tables, r_lengths, r_tokens, r_temps, r_keys,
+                r_budgets, r_hist, r_hist_len, r_caps, staged)
+
+    def _fill_admission_ring(self) -> None:
+        """Top the pending-lane ring up from the queue.  Each staged
+        entry runs the FULL admission path (fair order, quota, prefix
+        cache, reservation) into a detached ``_Slot``, then prefills
+        its prompt synchronously through the warmed standalone chunk
+        shapes — by launch time it is indistinguishable from a lane
+        that finished prefill in an engine slot, minus the lane
+        binding (the device performs that at a span boundary; _admit
+        does it host-side if the loop never activates the entry).
+
+        Ring fill never preempts: staging a pending lane is not worth
+        evicting a running one.  It never touches ``_inflight`` either
+        — the pipelined step may hold a dispatch whose effects are
+        still unconsumed."""
+        ec = self.engine_config
+        room = ec.admission_ring - len(self._ring_staged)
+        while room > 0:
+            if self.admission_gate is not None \
+                    and not self.admission_gate():
+                return
+            staged = None
+            for tenant in self._queue.order():
+                spec = self.tenants.get(tenant)
+                pending = self._queue.peek(tenant)
+                if self._quota_blocked(pending, spec):
+                    continue
+                cand = _Slot(-1, self._table_width)
+                outcome = self._try_admit(pending, spec, cand)
+                if outcome == "admitted":
+                    self._queue.pop(tenant)
+                    staged = cand
+                    break
+                if outcome == "quota":
+                    continue
+                return  # pool exhausted
+            if staged is None:
+                return
+            while staged.plan:
+                chunk = staged.plan.pop(0)
+                final, table, start, segment, last_row, temp, key = \
+                    self._prefill_lane(staged, chunk)
+                picked, pk, pv = self._dispatch(
+                    self._prefill_step, self.params, self.pool.k,
+                    self.pool.v, table, start, jnp.ones((1,), bool),
+                    segment, last_row, temp, key)
+                self.pool = replace(self.pool, k=pk, v=pv)
+                self.prefill_chunks += 1
+                self._charge_collectives(
+                    "prefill_chunk", "prefill", lanes=1,
+                    chunk=segment.shape[1])
+                self._queue.charge(staged.tenant, chunk[1])
+                if final:
+                    self._finish_prefill(
+                        staged, int(np.asarray(picked)[0]))
+            if staged.state == "decode":
+                self._ring_staged.append(staged)
+                room -= 1
+            # a request already done at its first token (max_new == 1
+            # or instant EOS) retired inside _finish_prefill and never
+            # stages — the loop continues with the queue advanced
+
+    def _run_spec_loop_step(self, plan: _StepPlan) -> None:
+        """Launch the SPECULATIVE device loop (device residency v2):
+        up to K draft-verify-accept units — plus ring admissions at
+        span boundaries — in ONE dispatch.  Like :meth:`_run_loop_step`
+        all unit-proportional bookkeeping defers to
+        :meth:`_consume_inflight`; the host draft that armed this plan
+        is discarded (the device re-drafts every unit itself from its
+        on-device history windows — scheduling-only, see
+        :meth:`_plan_decode_phase`)."""
+        k_depth = self._loop_k
+        if self.engine_config.admission_ring:
+            self._fill_admission_ring()
+        decode_slots = plan.decode_slots
+        (tables, lengths, active, tokens, temps, keys, budgets, hist,
+         hist_len, dcaps) = self._spec_loop_lanes(decode_slots, k_depth)
+        (r_tables, r_lengths, r_tokens, r_temps, r_keys, r_budgets,
+         r_hist, r_hist_len, r_caps, staged) = self._ring_lanes(k_depth)
+        out_p, out_a, out_d, units, head, pk, pv = self._dispatch(
+            self._spec_loops[k_depth], self.params, self.pool.k,
+            self.pool.v,
+            jnp.asarray(tables), jnp.asarray(lengths),
+            jnp.asarray(active), jnp.asarray(tokens),
+            jnp.asarray(temps), jnp.asarray(keys),
+            jnp.asarray(budgets), jnp.asarray(hist),
+            jnp.asarray(hist_len), jnp.asarray(dcaps),
+            jnp.asarray(r_tables), jnp.asarray(r_lengths),
+            jnp.asarray(r_tokens), jnp.asarray(r_temps),
+            jnp.asarray(r_keys), jnp.asarray(r_budgets),
+            jnp.asarray(r_hist), jnp.asarray(r_hist_len),
+            jnp.asarray(r_caps),
+            jnp.asarray(len(staged), jnp.int32))
+        self.pool = replace(self.pool, k=pk, v=pv)
+        self.spec_loop_launches += 1
+        self._inflight = ("spec_loop",
+                          (out_p, out_a, out_d, units, head,
+                           list(decode_slots), staged), None)
+
     def _run_mixed_step(self, decode_slots: List[_Slot], p_slot: _Slot,
                         chunk: Tuple[int, int, int]) -> None:
         """The stall-free fused dispatch: every decode lane advances
@@ -2748,8 +3133,21 @@ class ServingEngine:
                     lanes=self.engine_config.num_slots,
                     span=units * span)
                 emitted = np.asarray(ring)[: units * span]
+                # exit reason + realized depth BEFORE acceptance (the
+                # acceptance walk retires slots, destroying the lane
+                # state the derivation reads)
+                self._observe_loop_exit(slots, emitted, budgets, units,
+                                        units * span)
                 self._accept_decode(slots, emitted, budgets,
                                     n_steps=units * span)
+            elif kind == "spec_loop":
+                (out_p, out_a, out_d, units_dev, head_dev, slots,
+                 staged) = decode_part
+                self._accept_spec_loop(
+                    slots, staged, np.asarray(out_p),
+                    np.asarray(out_a), np.asarray(out_d),
+                    int(np.asarray(units_dev)),
+                    int(np.asarray(head_dev)))
             else:
                 emitted, slots, budgets = decode_part
                 self._accept_decode(slots, np.asarray(emitted), budgets)
@@ -2920,6 +3318,170 @@ class ServingEngine:
                 hist[1] += rate
                 _bucket_observe(hist[0], rate, SPEC_ACCEPT_BUCKETS)
             self._maybe_retire(slot, slot.generated[-1])
+
+    def _observe_loop_exit(self, slots: List[_Slot],
+                           emitted: np.ndarray, budgets: np.ndarray,
+                           units: int, n_steps: int) -> None:
+        """Derive the plain (v1) loop's exit reason from the drained
+        ring BEFORE acceptance retires slots, and observe the realized
+        fusion depth.  Priority: an EOS death beats a budget death
+        beats running all K units (the v1 loop has no ring and no
+        in-loop drafting, so ring_empty/redraft never apply)."""
+        ec = self.engine_config
+        eos_death = budget_death = False
+        for slot in slots:
+            i = slot.idx
+            take = min(int(budgets[i]), n_steps)
+            if ec.eos_token is not None and any(
+                    int(emitted[t, i]) == ec.eos_token
+                    for t in range(take)):
+                eos_death = True
+            elif int(budgets[i]) <= n_steps:
+                budget_death = True
+        if eos_death:
+            reason = "stop"
+        elif budget_death:
+            reason = "retire"
+        else:
+            reason = "budget"
+        self.loop_exit_reasons[reason] += 1
+        self.loop_depth_sum += units
+        self.loop_depth_count += 1
+
+    def _accept_spec_loop(self, decode_slots: List[_Slot],
+                          staged: List[_Slot], out_p: np.ndarray,
+                          out_a: np.ndarray, out_d: np.ndarray,
+                          units: int, head: int) -> None:
+        """Host replay of a speculative loop launch: the device's
+        per-unit acceptance walk, verbatim — unit u's lane i emitted
+        ``min(accepted prefix + 1, remaining budget)`` tokens from
+        ``out_p[u, i]``, truncated at EOS (inclusive), so the replay
+        reconstructs exactly the stream K separate verify rounds would
+        have produced.  Ring activations rebind a retired lane to the
+        next staged entry in the device's exact order (lane index
+        ascending within a span boundary, ring entries head-first);
+        activated entries that survive the launch are bound into their
+        lane's now-free engine slot, so later steps see them as
+        ordinary decode lanes.
+
+        Also the deferred unit-proportional bookkeeping half of
+        :meth:`_run_spec_loop_step` (counters, collective charges,
+        per-round adaptive-width updates), mirroring
+        :meth:`_accept_verify` round for round."""
+        ec = self.engine_config
+        w = 1 + ec.draft_len
+        self.verify_steps += units
+        self.spec_loop_units += units
+        for _ in range(units):
+            self._charge_collectives(
+                "verify_span", "verify", lanes=ec.num_slots, width=w)
+        self.loop_depth_sum += units
+        self.loop_depth_count += 1
+        owner: Dict[int, _Slot] = {s.idx: s for s in decode_slots}
+        dead: Dict[int, bool] = {s.idx: False for s in decode_slots}
+        next_staged = 0
+        unrefilled_eos = unrefilled_budget = False
+        for u in range(units):
+            now = time.monotonic()
+            died: List[int] = []
+            for i in sorted(owner):
+                if dead[i]:
+                    continue
+                own = owner[i]
+                k = int(out_d[u, i])
+                m = int(out_a[u, i])
+                rem = own.max_new - len(own.generated)
+                emit = min(m + 1, rem)
+                accepted = 0
+                hit_eos = False
+                for t in range(emit):
+                    tok = int(out_p[u, i, t])
+                    own.length += 1
+                    own.generated.append(tok)
+                    self.tokens_generated += 1
+                    accepted += 1
+                    if (ec.eos_token is not None
+                            and tok == ec.eos_token):
+                        hit_eos = True
+                        break
+                if accepted:
+                    own.drafter.extend(own.generated[-accepted:])
+                    self.tenant_tokens[own.tenant] = \
+                        self.tenant_tokens.get(own.tenant, 0) \
+                        + accepted
+                    self._queue.charge(own.tenant, accepted)
+                    gap = now - (own.last_token_at
+                                 if own.last_token_at is not None
+                                 else now)
+                    self._observe_tbt(gap / accepted, accepted,
+                                      own.tenant)
+                    own.last_token_at = now
+                if k:
+                    rate = m / k
+                    own.accept_rate = (0.5 * own.accept_rate
+                                       + 0.5 * rate)
+                    if self._tuner is not None:
+                        own.draft_width = \
+                            self._tuner.lane_draft_width(
+                                own.accept_rate,
+                                self._draft_width_cap)
+                    elif own.accept_rate >= 0.75:
+                        own.draft_width = min(own.draft_width * 2,
+                                              ec.draft_len)
+                    elif own.accept_rate <= 0.25:
+                        own.draft_width = max(own.draft_width // 2, 1)
+                    tenant = own.tenant
+                    self.spec_drafted[tenant] = \
+                        self.spec_drafted.get(tenant, 0) + k
+                    self.spec_accepted[tenant] = \
+                        self.spec_accepted.get(tenant, 0) \
+                        + min(m, accepted)
+                    hist = self._spec_accept.setdefault(
+                        tenant,
+                        [[0] * (len(SPEC_ACCEPT_BUCKETS) + 1), 0.0])
+                    hist[1] += rate
+                    _bucket_observe(hist[0], rate, SPEC_ACCEPT_BUCKETS)
+                if hit_eos or len(own.generated) >= own.max_new:
+                    self._maybe_retire(own, own.generated[-1])
+                    died.append(i)
+                    if next_staged >= head:
+                        # this death went unrefilled: it can only be
+                        # the exit unit (the cond checks occupied-but-
+                        # dead lanes at every span boundary)
+                        if hit_eos:
+                            unrefilled_eos = True
+                        else:
+                            unrefilled_budget = True
+            for i in died:
+                if next_staged < head:
+                    owner[i] = staged[next_staged]
+                    next_staged += 1
+                else:
+                    dead[i] = True
+        if next_staged != head:
+            raise RuntimeError(
+                f"spec-loop replay diverged: device activated {head} "
+                f"ring entries, host replay saw {next_staged}")
+        for i, own in owner.items():
+            if own.idx == -1 and own.state == "decode":
+                # an activated staged entry that survived the launch:
+                # its lane's engine slot retired mid-loop, so the slot
+                # is free — bind the survivor into it
+                self._bind_staged(own, self._slots[i])
+        for entry in staged[:next_staged]:
+            self._ring_staged.remove(entry)
+        if unrefilled_eos or unrefilled_budget:
+            if ec.admission_ring > 0:
+                reason = "ring_empty"
+            elif unrefilled_eos:
+                reason = "stop"
+            else:
+                reason = "retire"
+        elif units < self._loop_k:
+            reason = "redraft"
+        else:
+            reason = "budget"
+        self.loop_exit_reasons[reason] += 1
 
     def _maybe_retire(self, slot: _Slot, token: int) -> None:
         eos = self.engine_config.eos_token

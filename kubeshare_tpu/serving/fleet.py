@@ -707,9 +707,24 @@ class ReplicaFleet:
            carve, exactly as retirement does.
 
         Recovery latency is measured last-proof-of-life -> recovery
-        complete, so the grace epochs' detection cost is included."""
+        complete, so the grace epochs' detection cost is included.
+
+        Before any of that, the dead replica's IN-FLIGHT launch is
+        drained: a kill lands at the top of ``step()`` — before
+        ``_consume_inflight`` — so a device-loop (or verify-in-loop)
+        launch that completed on the wire may still hold K units of
+        emitted tokens, retirements, and ring activations that never
+        reached host state.  Consuming it first means the orphan
+        resume below starts from the true post-launch position instead
+        of silently replaying a whole launch's worth of tokens (the
+        replay would be bit-exact too, but retired-in-launch requests
+        would be re-admitted as orphans and ring-activated lanes would
+        sit unbound)."""
         handle.state = "failed"
         handle.fail_cause = cause
+        for eng in _pool_engines(handle.engine):
+            if hasattr(eng, "_consume_inflight"):
+                eng._consume_inflight()
         self.replica_failures[cause] = \
             self.replica_failures.get(cause, 0) + 1
         self.salvaged_tokens += self._salvage_trie(handle)
@@ -801,6 +816,15 @@ class ReplicaFleet:
                 if slot.state == "free":
                     continue
                 orphans.append((_slot_resume_pending(slot), slot.result))
+            # admission-ring lanes staged for the dead replica's next
+            # verify-in-loop launch: prefilled (their device K/V died
+            # with the pool) but never bound into an engine slot — the
+            # staged slot carries the full host-side resume record, so
+            # the standard slot arithmetic recovers them too
+            for staged in getattr(eng, "_ring_staged", []):
+                orphans.append(
+                    (_slot_resume_pending(staged), staged.result))
+            eng._ring_staged = []
             for tenant, lane in getattr(eng, "_queue")._lanes.items():
                 while lane.items:
                     pending = lane.items.popleft()[1]
@@ -1008,7 +1032,7 @@ class ReplicaFleet:
                 handle.missed_epochs = 0
                 healthy = True
             if self.watchdog_budget_s is not None \
-                    and self._clock() - t0 > self.watchdog_budget_s:
+                    and self._clock() - t0 > self._step_budget_s(handle):
                 handle.watchdog_trips += 1
             else:
                 handle.watchdog_trips = 0
@@ -1031,6 +1055,19 @@ class ReplicaFleet:
                 and self._steps % self.autoscale_every == 0:
             self._autoscale_tick()
         return worked
+
+    def _step_budget_s(self, handle: ReplicaHandle) -> float:
+        """The watchdog budget for ONE step of this replica: the
+        configured per-dispatch budget scaled by the unit depth of the
+        replica's most recent launch.  A K-unit device-loop (or
+        verify-in-loop) launch legitimately does K dispatches' work in
+        one step — flagging it against a single-dispatch budget would
+        declare every deep launch a hang, so the budget follows the
+        launch envelope while a genuinely stuck dispatch still trips
+        at K times the budget."""
+        units = max((getattr(e, "last_launch_units", 1)
+                     for e in _pool_engines(handle.engine)), default=1)
+        return self.watchdog_budget_s * max(1, units)
 
     def _autoscale_tick(self) -> None:
         decision = self.scaling.decide(self)

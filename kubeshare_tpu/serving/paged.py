@@ -64,6 +64,7 @@ from ..models.decoding import (
 )
 from ..models.transformer import TransformerConfig, _rms_norm
 from ..ops.rope import apply_rope
+from .drafter import ngram_propose_rows
 
 
 def paged_gather_kv(pool_k, pool_v, block_table):
@@ -544,6 +545,282 @@ def paged_verify_span(
         axis=1)  # [S, W]
     accepts = speculative_acceptance(tokens[:, 1:], picked)
     return picked, accepts, jnp.stack(new_k), jnp.stack(new_v)
+
+
+def _spec_loop_impl(
+    verify_fn,
+    k_units: int,
+    eos,
+    max_order: int,
+    redraft: float,
+    width: int,
+    pool_k,
+    pool_v,
+    tables,
+    lengths,
+    active,
+    tokens,
+    temps,
+    keys,
+    budgets,
+    hist,
+    hist_len,
+    draft_caps,
+    ring_tables,
+    ring_lengths,
+    ring_tokens,
+    ring_temps,
+    ring_keys,
+    ring_budgets,
+    ring_hist,
+    ring_hist_len,
+    ring_caps,
+    ring_count,
+):
+    """Device residency v2's shared body — verify-in-loop plus the
+    pending-lane admission ring — parameterized by the width-W verify
+    dispatch (``paged_verify_span`` here, the shard_map-local twin in
+    serving/sharded.py) so both engines run the IDENTICAL loop
+    construction.
+
+    Each while-loop iteration is one VERIFY-UNIT: draft on device
+    (:func:`~kubeshare_tpu.serving.drafter.ngram_propose_rows` over the
+    on-device right-aligned token-history window ``hist``), run the
+    width-W verify dispatch, apply the exact acceptance rule, and
+    advance every lane by its accepted prefix plus the correction pick
+    — host-free.  Bit-exactness with the K=1 engine needs NO agreement
+    between the device drafter and the host drafter: verification is
+    exact-match against the engine's own pick policy, each column
+    consuming the key of its emission number (``keys[s, done[s]+i]``
+    where ``done`` counts the lane's in-loop emissions — a rejected
+    column re-consumes the SAME key at the SAME emission number next
+    unit, exactly as the host verify path re-slices the schedule), so
+    draft content moves only the acceptance RATE.  Rejected columns'
+    stale K/V rows sit at positions past the advanced length; the next
+    unit's verify writes start exactly at the new length and cover the
+    same width, overwriting them before any causal band attends — the
+    identical write-then-attend argument the host verify path already
+    relies on between rounds.
+
+    Exit — at a unit boundary, the loop stops the moment host
+    scheduling could differ: an occupied lane died (budget spent or
+    EOS) and the ring had no pending lane to activate, the unit budget
+    ``k_units`` ran out, the round's aggregate acceptance collapsed
+    below the ``redraft`` threshold, or no lane could draft at all (the host
+    falls back to the span loop rather than paying width-1 verify
+    units).
+
+    The admission ring: ``ring_*`` carry up to R pre-marshaled pending
+    lanes (prompt blocks already prefilled, first token picked, PRNG
+    schedules sliced) in admission order; ``ring_count`` is the number
+    of real entries.  When an occupied lane dies at a unit boundary,
+    the next ring entry is activated INTO that lane — in ascending lane
+    order, so the host can replay activations deterministically — and
+    the loop keeps going where v1 would exit, replan, and relaunch.
+    Activation only ever targets a lane that was occupied at launch, so
+    host-side free slots stay untouched.
+
+    Returns (picked [K, S, W], accepted [K, S], drafted [K, S],
+    units [], ring_used [], pool_k, pool_v).  Rows at and past
+    ``units`` are zeros the host never reads; ``accepted`` is already
+    clamped to ``drafted``.  The host replays emissions (budget/EOS
+    truncation, retirement, ring activation) from these arrays alone —
+    the arithmetic below is deliberately reproducible host-side.  An
+    all-inactive call (warmup) runs zero units.
+    """
+    s = tables.shape[0]
+    h = hist.shape[1]
+    ring_size = ring_tables.shape[0]
+    col = jnp.arange(width, dtype=jnp.int32)[None, :]
+
+    def body(carry):
+        (u, out_p, out_a, out_d, pk, pv, tbl, lens, alive, toks, tmp,
+         kbuf, rem, done, hst, hlen, dcap, occ, head, _rd) = carry
+
+        # -- draft: per-lane width is DATA (cap, budget), never a shape
+        cap = jnp.clip(jnp.minimum(dcap, rem - 1), 0, width - 1)
+        cap = jnp.where(alive, cap, 0)
+        draft, n_draft = ngram_propose_rows(
+            hst, hlen, cap, max_order, width - 1)
+
+        # -- verify: column 0 is the lane's last emitted token, columns
+        # 1..n_draft the proposal, -1 pad past that (never acceptable)
+        ver = jnp.concatenate([toks[:, None], draft], axis=1)
+        ver = jnp.where(alive[:, None], ver, -1)
+        widths = 1 + n_draft
+        kidx = jnp.clip(done[:, None] + col, 0, kbuf.shape[1] - 1)
+        ukeys = jnp.take_along_axis(kbuf, kidx[:, :, None], axis=1)
+        picked, accepts, pk, pv = verify_fn(
+            pk, pv, tbl, lens, alive, ver, widths, tmp, ukeys)
+
+        # -- emission arithmetic (the host replays exactly this)
+        m = jnp.minimum(accepts, n_draft)
+        emit = jnp.minimum(m + 1, rem)
+        if eos is not None:
+            is_eos = (picked == eos) & (col < emit[:, None])
+            first_eos = jnp.min(jnp.where(is_eos, col, width), axis=1)
+            emit = jnp.minimum(emit, first_eos + 1)
+            eos_hit = first_eos < width
+        else:
+            eos_hit = jnp.zeros_like(alive)
+        emit = jnp.where(alive, emit, 0)
+        eos_hit = eos_hit & alive
+
+        out_p = jax.lax.dynamic_update_slice(out_p, picked[None],
+                                             (u, 0, 0))
+        out_a = jax.lax.dynamic_update_slice(
+            out_a, jnp.where(alive, m, 0)[None], (u, 0))
+        out_d = jax.lax.dynamic_update_slice(
+            out_d, jnp.where(alive, n_draft, 0)[None], (u, 0))
+
+        # -- re-draft exit flag, judged on the lanes as they entered
+        # the unit: the round's AGGREGATE acceptance collapsed (a
+        # single cold lane must not end a K-unit launch for the whole
+        # batch — its verify columns are wasted work bounded by W, and
+        # its on-device history refreshes next unit anyway), or
+        # nothing drafted at all (width-1 units are worse than the
+        # span loop, so hand back)
+        drafting = alive & (n_draft > 0)
+        round_m = jnp.sum(jnp.where(drafting,
+                                    m.astype(jnp.float32), 0.0))
+        round_n = jnp.sum(jnp.where(drafting,
+                                    n_draft.astype(jnp.float32), 0.0))
+        rd = (round_m < redraft * round_n) | ~jnp.any(drafting)
+
+        # -- advance lane state by the emitted prefix
+        lens = lens + emit
+        last = jnp.take_along_axis(
+            picked, jnp.clip(emit - 1, 0, width - 1)[:, None],
+            axis=1)[:, 0]
+        toks = jnp.where(emit > 0, last, toks)
+        rem = rem - emit
+        done = done + emit
+        cat = jnp.concatenate([hst, picked], axis=1)
+        hidx = emit[:, None] + jnp.arange(h, dtype=jnp.int32)[None, :]
+        hst = jnp.take_along_axis(cat, hidx, axis=1)
+        hlen = jnp.minimum(hlen + emit, h)
+        alive = alive & (rem > 0) & ~eos_hit
+
+        # -- admission ring: activate pending lanes into retired ones,
+        # ascending lane order (host replay depends on this order)
+        if ring_size > 0:
+            def admit(i, st):
+                (tbl, lens, toks, tmp, kbuf, rem, done, hst, hlen,
+                 dcap, alive, head) = st
+                can = occ[i] & ~alive[i] & (head < ring_count)
+                hsel = jnp.minimum(head, ring_size - 1)
+
+                def sel(cur, new):
+                    return jnp.where(can, new, cur)
+
+                tbl = tbl.at[i].set(sel(tbl[i], ring_tables[hsel]))
+                lens = lens.at[i].set(sel(lens[i], ring_lengths[hsel]))
+                toks = toks.at[i].set(sel(toks[i], ring_tokens[hsel]))
+                tmp = tmp.at[i].set(sel(tmp[i], ring_temps[hsel]))
+                kbuf = kbuf.at[i].set(sel(kbuf[i], ring_keys[hsel]))
+                rem = rem.at[i].set(sel(rem[i], ring_budgets[hsel]))
+                done = done.at[i].set(jnp.where(can, 0, done[i]))
+                hst = hst.at[i].set(sel(hst[i], ring_hist[hsel]))
+                hlen = hlen.at[i].set(
+                    sel(hlen[i], ring_hist_len[hsel]))
+                dcap = dcap.at[i].set(sel(dcap[i], ring_caps[hsel]))
+                alive = alive.at[i].set(alive[i] | can)
+                head = head + can.astype(jnp.int32)
+                return (tbl, lens, toks, tmp, kbuf, rem, done, hst,
+                        hlen, dcap, alive, head)
+
+            (tbl, lens, toks, tmp, kbuf, rem, done, hst, hlen, dcap,
+             alive, head) = jax.lax.fori_loop(
+                0, s, admit,
+                (tbl, lens, toks, tmp, kbuf, rem, done, hst, hlen,
+                 dcap, alive, head))
+
+        return (u + 1, out_p, out_a, out_d, pk, pv, tbl, lens, alive,
+                toks, tmp, kbuf, rem, done, hst, hlen, dcap, occ,
+                head, rd)
+
+    def cond(carry):
+        (u, out_p, out_a, out_d, pk, pv, tbl, lens, alive, toks, tmp,
+         kbuf, rem, done, hst, hlen, dcap, occ, head, rd) = carry
+        # continue while units remain, no occupied lane sits dead
+        # (ring exhausted or ring-less retire), acceptance holds, and
+        # at least one lane is alive — jnp.any(alive) also exits an
+        # all-inactive (warmup) call at unit 0
+        return ((u < k_units) & jnp.any(alive)
+                & ~jnp.any(occ & ~alive) & ~rd)
+
+    out_p = jnp.zeros((k_units, s, width), jnp.int32)
+    out_a = jnp.zeros((k_units, s), jnp.int32)
+    out_d = jnp.zeros((k_units, s), jnp.int32)
+    carry = (jnp.asarray(0, jnp.int32), out_p, out_a, out_d,
+             pool_k, pool_v, tables, lengths, active, tokens, temps,
+             keys, budgets, jnp.zeros((s,), jnp.int32), hist, hist_len,
+             draft_caps, active, jnp.asarray(0, jnp.int32),
+             jnp.asarray(False, bool))
+    out = jax.lax.while_loop(cond, body, carry)
+    (units, out_p, out_a, out_d, pk, pv, _, _, _, _, _, _, _, _, _,
+     _, _, _, head, _) = out
+    return out_p, out_a, out_d, units, head, pk, pv
+
+
+def paged_spec_loop(
+    params,
+    config: TransformerConfig,
+    pick_fn,
+    k_units: int,
+    eos,
+    max_order: int,
+    redraft: float,
+    width: int,
+    pool_k,
+    pool_v,
+    tables,
+    lengths,
+    active,
+    tokens,
+    temps,
+    keys,
+    budgets,
+    hist,
+    hist_len,
+    draft_caps,
+    ring_tables,
+    ring_lengths,
+    ring_tokens,
+    ring_temps,
+    ring_keys,
+    ring_budgets,
+    ring_hist,
+    ring_hist_len,
+    ring_caps,
+    ring_count,
+):
+    """Up to ``k_units`` consecutive draft-verify units in ONE dispatch
+    — the speculative device-resident loop (device residency v2).
+
+    ``keys`` [S, k_units*width, 2] is each lane's flat step-key window
+    from its NEXT emission number (a unit at in-loop emission count
+    ``done`` consumes keys ``done..done+width-1`` — the same slice K=1
+    verify dispatches would take); ``budgets`` [S] the remaining
+    emission budgets at launch; ``hist``/``hist_len`` the right-aligned
+    on-device drafting windows; ``draft_caps`` [S] the per-lane
+    adaptive draft widths (data, not shape).  ``ring_*`` carry up to R
+    pre-marshaled pending lanes activated in admission order when an
+    occupied lane retires.  See :func:`_spec_loop_impl` for boundary
+    semantics and the bit-exactness-with-K=1 argument.
+    """
+
+    def verify_fn(pk, pv, tbl, lens, alive, toks, widths, tmp, ukeys):
+        return paged_verify_span(
+            params, config, pick_fn, pk, pv, tbl, lens, alive, toks,
+            widths, tmp, ukeys)
+
+    return _spec_loop_impl(
+        verify_fn, k_units, eos, max_order, redraft, width,
+        pool_k, pool_v, tables, lengths, active, tokens, temps, keys,
+        budgets, hist, hist_len, draft_caps, ring_tables, ring_lengths,
+        ring_tokens, ring_temps, ring_keys, ring_budgets, ring_hist,
+        ring_hist_len, ring_caps, ring_count)
 
 
 def paged_mixed_verify_step(

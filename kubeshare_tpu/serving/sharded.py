@@ -81,8 +81,8 @@ from ..models.decoding import _attend_cached, speculative_acceptance
 from ..models.transformer import TransformerConfig, _rms_norm
 from ..ops.rope import apply_rope
 from ..parallel.mesh import MeshSpec, make_mesh, param_spec_tree, shard_params
-from .paged import (_decode_loop_impl, _moe_or_mlp, paged_copy_block,
-                    paged_upload_block)
+from .paged import (_decode_loop_impl, _moe_or_mlp, _spec_loop_impl,
+                    paged_copy_block, paged_upload_block)
 
 # the paged pool is [n_layers, num_blocks, kv_heads, block_size, head_dim];
 # head-sharding splits axis 2, so every block's rows for a device's KV
@@ -540,6 +540,42 @@ class ShardedServingContext:
         return self._smap(
             local, (self._pspecs, kv, kv, r, r, r, r, r, r, r),
             (r, r, kv, kv))
+
+    def spec_loop(self, pick_fn, k_units: int, eos, max_order: int,
+                  redraft: float, width: int):
+        """Device residency v2's sharded twin: verify-in-loop plus the
+        admission ring inside ONE shard_map program
+        (``paged._spec_loop_impl`` over the local verify span).  Like
+        ``decode_loop``, the while-loop condition reads only replicated
+        values — the gathered logits make every device's per-column
+        picks, acceptance counts, alive masks, re-draft flag, and ring
+        head identical — so all devices take the same number of units
+        and every non-pool output is replicated by construction."""
+        cfg, dec = self.config, self.decision
+        kv, r = self.kv_spec, P()
+
+        def local(w, pk, pv, tables, lengths, active, tokens, temps,
+                  keys, budgets, hist, hist_len, draft_caps,
+                  ring_tables, ring_lengths, ring_tokens, ring_temps,
+                  ring_keys, ring_budgets, ring_hist, ring_hist_len,
+                  ring_caps, ring_count):
+            def verify_fn(spk, spv, tbl, lens, alive, toks, widths,
+                          tmp, ukeys):
+                return _local_verify_span(
+                    w, cfg, dec, pick_fn, spk, spv, tbl, lens, alive,
+                    toks, widths, tmp, ukeys)
+
+            return _spec_loop_impl(
+                verify_fn, k_units, eos, max_order, redraft, width,
+                pk, pv, tables, lengths, active, tokens, temps, keys,
+                budgets, hist, hist_len, draft_caps, ring_tables,
+                ring_lengths, ring_tokens, ring_temps, ring_keys,
+                ring_budgets, ring_hist, ring_hist_len, ring_caps,
+                ring_count)
+
+        return self._smap(
+            local, (self._pspecs, kv, kv) + (r,) * 20,
+            (r, r, r, r, r, kv, kv))
 
     def verify_span(self, pick_fn):
         cfg, dec = self.config, self.decision

@@ -151,6 +151,7 @@ class TestSandbox:
                 return {"mixed_prefill_budget": 999,
                         "steps_per_launch": 3,
                         "draft_width_cap": True,
+                        "loop_draft_width": 64,
                         "no_such_knob": 1}
 
         config, params = model
@@ -164,12 +165,45 @@ class TestSandbox:
         assert eng._mixed_budget == 8  # untouched hand-set values
         assert eng._loop_k == 4
         assert eng._draft_width_cap == 4
+        assert eng._loop_draft_cap == 4
         dirs = {d for (_, d) in eng._tuner.decisions}
         assert dirs == {"rejected"}
         rejected = {k for (k, d) in eng._tuner.decisions}
         assert rejected == {"mixed_prefill_budget", "steps_per_launch",
-                            "draft_width_cap", "no_such_knob"}
+                            "draft_width_cap", "loop_draft_width",
+                            "no_such_knob"}
         assert eng._tuner.trajectory == []
+
+    def test_loop_draft_width_knob_gated_on_spec_loop(self, model):
+        """The in-loop draft width knob exists only on a verify-in-loop
+        engine (speculative + loop depth > 1); in-envelope proposals
+        apply, and a non-loop speculative engine treats the knob name
+        as unknown — rejected, never applied."""
+        from kubeshare_tpu.serving import TuningPolicy
+
+        class Narrow(TuningPolicy):
+            def propose(self, signals, knobs, cost_model):
+                return {"loop_draft_width": 2}
+
+        config, params = model
+        eng = _engine(params, config, speculative=True, draft_len=4,
+                      steps_per_launch=4, autotune=True,
+                      autotune_interval=2, tuning_policy=Narrow())
+        assert "loop_draft_width" in eng._tuner.knobs
+        assert eng._tuner.knobs["loop_draft_width"].spec.values \
+            == (1, 2, 4)
+        _run(eng, _requests(n=3))
+        assert eng._loop_draft_cap == 2
+        assert ("loop_draft_width", "down") in eng._tuner.decisions
+        # no spec loop warmed (K=1): the knob is not even registered
+        flat = _engine(params, config, speculative=True, draft_len=4,
+                       autotune=True, autotune_interval=2,
+                       tuning_policy=Narrow())
+        assert "loop_draft_width" not in flat._tuner.knobs
+        _run(flat, _requests(n=3))
+        assert flat._loop_draft_cap == 4
+        assert flat._tuner.decisions.get(
+            ("loop_draft_width", "rejected"), 0) > 0
 
     def test_crashing_policy_is_sandboxed(self, model):
         from kubeshare_tpu.serving import TuningPolicy

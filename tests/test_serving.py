@@ -2869,6 +2869,168 @@ class TestDeviceLoop:
                 decode_priority=2)
 
 
+class TestSpecLoop:
+    """Device residency v2: drafted rounds run INSIDE the device loop —
+    each unit drafts via on-device n-gram suffix match, verifies at
+    width W and applies acceptance without leaving device — and the
+    pending-lane admission ring activates pre-marshaled lanes at span
+    boundaries when a lane retires.  The oracle is the K=1 non-loop
+    speculative engine: bit-exact streams, greedy and sampled, with
+    zero new compiled shapes after warmup."""
+
+    def _engine(self, params, config, k, **overrides):
+        from kubeshare_tpu.serving import EngineConfig, ServingEngine
+
+        kwargs = dict(num_slots=3, block_size=4, num_blocks=41,
+                      max_request_len=48, prefill_chunk=8,
+                      speculative=True, steps_per_launch=k)
+        kwargs.update(overrides)
+        return ServingEngine(params, config, EngineConfig(**kwargs))
+
+    def _streams(self, engine, reqs):
+        from kubeshare_tpu.serving import Request
+
+        for req in reqs:
+            engine.submit(Request(**req))
+        return {rid: r.tokens for rid, r in engine.run().items()}
+
+    def _spec_reqs(self, n=4, new=10, sampled=()):
+        """Repetitive prompts (tiled patterns) so the n-gram drafter
+        proposes on every lane and decode rounds go all-drafted —
+        the rounds the spec loop exists to absorb."""
+        rng = np.random.default_rng(81)
+        reqs = []
+        for i in range(n):
+            pat = rng.integers(0, 64, 4)
+            prompt = np.concatenate(
+                [np.tile(pat, 3), rng.integers(0, 64, 2)])
+            req = dict(rid=f"r{i}", prompt=prompt, max_new_tokens=new)
+            if i in sampled:
+                req.update(temperature=0.8,
+                           rng=jax.random.PRNGKey(82 + i))
+            reqs.append(req)
+        return reqs
+
+    def test_streams_bit_exact_spec_loop_on_vs_off(self):
+        """Loop-on vs loop-off, token for token, greedy AND sampled,
+        across GQA and windowed attention — the bit-exactness argument
+        (verification is exact-match against the engine's own pick
+        policy keyed by emission number, so the device drafter's
+        scheduling-only differences from the host drafter can change
+        acceptance RATE, never a stream) made empirical."""
+        cases = {
+            "gqa_rope": dict(n_kv_heads=2, positional="rope"),
+            "windowed": dict(attention_window=6),
+        }
+        for name, extra in cases.items():
+            config = _small_config(**extra)
+            params = transformer_init(jax.random.PRNGKey(0), config)
+            sampled = (1, 2) if name == "gqa_rope" else ()
+            kwargs = (dict(top_k=10, top_p=0.95)
+                      if name == "gqa_rope" else {})
+            workload = self._spec_reqs(n=3, new=12, sampled=sampled)
+            on = self._engine(params, config, 4, **kwargs)
+            off = self._engine(params, config, 1, **kwargs)
+            got = self._streams(on, list(workload))
+            want = self._streams(off, list(workload))
+            assert got == want, name
+            assert on.spec_loop_launches > 0, name
+            assert on.spec_loop_units > 0, name
+            assert off.spec_loop_launches == 0, name
+
+    def test_admission_ring_activates_lanes_bit_exact(self):
+        """More requests than slots with the ring armed: retiring lanes
+        hand their slot to pre-marshaled pending lanes AT SPAN
+        BOUNDARIES inside a launch (prefilled ahead, PRNG schedule
+        written ahead, key index reset on activation) — and the streams
+        still match the ring-off, loop-off engine exactly."""
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        workload = self._spec_reqs(n=7, new=8, sampled=(2, 5))
+        kwargs = dict(top_k=10, top_p=0.95)
+        ring = self._engine(params, config, 4, admission_ring=2,
+                            **kwargs)
+        off = self._engine(params, config, 1, **kwargs)
+        got = self._streams(ring, list(workload))
+        want = self._streams(off, list(workload))
+        assert got == want
+        assert ring.spec_loop_launches > 0
+        # ring pressure was real: either a staged lane activated inside
+        # a launch or a launch exited starving (ring_empty) — both are
+        # the ring path, and on this 7-request/3-slot trace at least
+        # one of the two must have happened
+        assert (ring.loop_exit_reasons["ring_empty"] > 0
+                or ring.spec_loop_units > ring.spec_loop_launches)
+        assert ring.allocator.blocks_in_use == 0
+        assert ring._ring_staged == []
+
+    def test_exit_reason_and_depth_metrics(self):
+        """Satellite: every launch lands exactly one exit-reason count,
+        and the realized-depth summary reports unit depth directly —
+        sum = units, count = launches — so the bench reads fusion depth
+        from the metrics plane instead of dividing counters."""
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        engine = self._engine(params, config, 4, admission_ring=2)
+        self._streams(engine, self._spec_reqs(n=6, new=8))
+        launches = engine.loop_launches + engine.spec_loop_launches
+        units = engine.loop_units + engine.spec_loop_units
+        assert launches > 0
+        assert sum(engine.loop_exit_reasons.values()) == launches
+        assert set(engine.loop_exit_reasons) == {
+            "retire", "budget", "stop", "redraft", "ring_empty"}
+        assert engine.loop_depth_count == launches
+        assert engine.loop_depth_sum == units
+        fams = {f.name: f for f in engine.collect_metrics()}
+        reasons = fams["kubeshare_serving_loop_exit_reason_total"]
+        by_reason = {s.labels["reason"]: s.value for s in reasons.samples}
+        assert by_reason == {k: v for k, v
+                             in engine.loop_exit_reasons.items()}
+        depth = fams["kubeshare_serving_loop_realized_depth"]
+        vals = {s.name.rsplit("_", 1)[-1]: s.value
+                for s in depth.samples}
+        assert vals["sum"] == units
+        assert vals["count"] == launches
+        su = fams["kubeshare_serving_spec_loop_units_total"]
+        assert sum(s.value for s in su.samples) == engine.spec_loop_units
+
+    def test_zero_recompiles_after_warmup(self):
+        """The verify-in-loop program (and its ring variant) is warmed
+        once per loop depth and never compiles again — greedy, sampled,
+        redraft exits, ring activations, admissions between launches."""
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        engine = self._engine(params, config, 4, admission_ring=2,
+                              top_k=10, top_p=0.95)
+        engine.warmup()
+        baseline = engine.compile_counts()
+        assert baseline["spec_loop"] >= 1
+        self._streams(engine, self._spec_reqs(n=6, new=9, sampled=(1, 4)))
+        assert engine.spec_loop_launches > 0
+        assert engine.compile_counts() == baseline
+
+    def test_config_validation_is_loud(self):
+        from kubeshare_tpu.serving import EngineConfig, ServingEngine
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        geo = dict(num_slots=2, block_size=4, num_blocks=13,
+                   max_request_len=32, prefill_chunk=8)
+        with pytest.raises(ValueError, match="admission_ring"):
+            ServingEngine(params, config, EngineConfig(
+                admission_ring=-1, **geo))
+        # the ring rides the verify-in-loop launch: it needs
+        # speculation, a real loop depth, and a decode-capable pool
+        for bad in (dict(admission_ring=2),
+                    dict(admission_ring=2, speculative=True),
+                    dict(admission_ring=2, speculative=True,
+                         steps_per_launch=2, mixed=False,
+                         pool_role="decode")):
+            with pytest.raises(ValueError, match="admission_ring"):
+                ServingEngine(params, config,
+                              EngineConfig(**{**geo, **bad}))
+
+
 class TestServingBenchSmoke:
     def test_smoke_ratio_and_zero_recompiles(self):
         """The bench's CPU smoke path: continuous vs run-to-completion

@@ -442,6 +442,50 @@ class TestShardedServing:
         assert engine.verify_steps > 0
         assert sum(engine.spec_drafted.values()) > 0
 
+    def test_spec_loop_sharded_bit_exact(self):
+        """Device residency v2 under the mesh: verify-in-loop launches
+        (with the admission ring armed) run through the shard_map twin
+        — the loop cond gathers logits so every device computes
+        identical picks, alive masks and ring heads — and the streams
+        are BIT-IDENTICAL to the single-device non-loop speculative
+        engine's, greedy AND sampled, zero recompiles after warmup."""
+        from kubeshare_tpu.serving import (EngineConfig, Request,
+                                           ServingEngine)
+
+        config = _small_config(n_kv_heads=2, positional="rope")
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        rng = np.random.default_rng(57)
+        reqs = []
+        for i in range(5):
+            pat = rng.integers(0, 64, 4)
+            prompt = np.concatenate([np.tile(pat, 3),
+                                     rng.integers(0, 64, 2)])
+            req = dict(rid=f"r{i}", prompt=prompt, max_new_tokens=9)
+            if i in (1, 3):
+                req.update(temperature=0.8,
+                           rng=jax.random.PRNGKey(58 + i))
+            reqs.append(req)
+        kwargs = dict(speculative=True, draft_len=4, top_k=10,
+                      top_p=0.95)
+        engine = _sharded_engine(params, config, steps_per_launch=4,
+                                 admission_ring=2, **kwargs)
+        engine.warmup()
+        baseline = engine.compile_counts()
+        assert baseline["spec_loop"] >= 1
+        for req in reqs:
+            engine.submit(Request(**req))
+        got = {rid: r.tokens for rid, r in engine.run().items()}
+        oracle = ServingEngine(params, config, EngineConfig(
+            num_slots=3, block_size=4, num_blocks=41,
+            max_request_len=48, prefill_chunk=8, **kwargs))
+        for req in reqs:
+            oracle.submit(Request(**req))
+        want = {rid: r.tokens for rid, r in oracle.run().items()}
+        assert got == want
+        assert engine.spec_loop_launches > 0
+        assert engine.spec_loop_units > 0
+        assert engine.compile_counts() == baseline
+
     def test_long_context_threshold_routes_bit_exact(self):
         """Past the threshold, prefill chunks re-shard Ulysses-style
         (sequence-parallel attention inside the program) — and the
