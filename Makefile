@@ -16,6 +16,7 @@
 #   make serve-fleet-smoke  replica-fleet routing bench, fast CPU path
 #   make serve-autotune-smoke  cost-model autotuner bench, fast CPU path
 #   make serve-chaos-smoke  fault-injection fleet recovery bench, fast CPU path
+#   make serve-fabric-smoke cluster KV fabric cross-process bench, fast CPU path
 #   make images          build the kubeshare-tpu:latest container image
 #   make image-check     validate everything the Dockerfile needs, sans docker
 #   make e2e-kind        kind-based end-to-end (skips cleanly without kind)
@@ -23,7 +24,7 @@
 IMAGE ?= kubeshare-tpu:latest
 DOCKER ?= $(shell command -v docker || command -v podman)
 
-.PHONY: all native test serve-smoke serve-prefix-smoke serve-qos-smoke serve-mixed-smoke serve-tier-smoke serve-spec-smoke serve-disagg-smoke serve-sharded-smoke serve-loop-smoke serve-loop-v2-smoke serve-fleet-smoke serve-autotune-smoke serve-chaos-smoke images image-check e2e-kind tsan clean
+.PHONY: all native test serve-smoke serve-prefix-smoke serve-qos-smoke serve-mixed-smoke serve-tier-smoke serve-spec-smoke serve-disagg-smoke serve-sharded-smoke serve-loop-smoke serve-loop-v2-smoke serve-fleet-smoke serve-autotune-smoke serve-chaos-smoke serve-fabric-smoke images image-check e2e-kind tsan clean
 
 all: native
 
@@ -74,6 +75,9 @@ serve-autotune-smoke:
 
 serve-chaos-smoke:
 	JAX_PLATFORMS=cpu python3 benchmarks/serving_bench.py --chaos --smoke
+
+serve-fabric-smoke:
+	JAX_PLATFORMS=cpu python3 benchmarks/serving_bench.py --fabric --smoke
 
 images: image-check
 ifeq ($(strip $(DOCKER)),)

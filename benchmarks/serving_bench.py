@@ -90,9 +90,11 @@ Run:
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --sharded --smoke
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --fleet
     JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --fleet --smoke
+    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --fabric
+    JAX_PLATFORMS=cpu python benchmarks/serving_bench.py --fabric --smoke
     make serve-smoke serve-prefix-smoke serve-qos-smoke serve-mixed-smoke \
          serve-tier-smoke serve-disagg-smoke serve-sharded-smoke \
-         serve-fleet-smoke
+         serve-fleet-smoke serve-fabric-smoke
 
 - ``--disagg`` switches to the DISAGGREGATED PREFILL/DECODE
   comparison: the long-prefill/steady-decode adversarial trace
@@ -461,6 +463,54 @@ def tiered_settings() -> dict:
     )
 
 
+def fabric_smoke_settings() -> dict:
+    """Seconds-fast cluster-KV-fabric path (CI, tests/test_serving.py):
+    three distinct 64-token documents primed on a PUBLISHER engine
+    whose tiny pool + tiny host tier force the demotion cascade onto
+    the mmap disk arena, exported to a prefix store and served by a
+    jax-free child PROCESS; the cold fabric-on arm fetches the chains
+    over TCP and adopts them before its first arrival, so even the
+    first touch of every document is a (remote-origin) tier hit
+    instead of a cold prefill."""
+    return dict(
+        d_model=128, n_layers=1, n_heads=4, n_kv_heads=2, d_ff=256,
+        vocab_size=512, max_seq_len=128,
+        num_requests=12,
+        num_slots=3, block_size=8, num_blocks=33,     # 32 usable
+        host_tier_bytes=600_000,                      # ~70 wire blocks
+        publisher_num_blocks=13,                      # 12 usable: churn
+        publisher_host_tier_bytes=18_000,             # ~4 blocks: spill
+        disk_tier_bytes=1 << 20,
+        max_request_len=128, prefill_chunk=16,
+        num_docs=3, doc_len=64, tail_lo=4, tail_hi=10,
+        new_lo=4, new_hi=10, publisher_new=4,
+        mean_interarrival_s=0.01, seed=0,
+    )
+
+
+def fabric_settings() -> dict:
+    """The fabric capture configuration (acceptance shape): four
+    192-token documents (48 blocks of shared working set at block_size
+    16) published through a 16-block pool + ~12-block host tier — the
+    cascade parks most of the corpus on disk — then promoted across
+    the process boundary into a cold engine at the tiered bench's
+    model scale."""
+    return dict(
+        d_model=256, n_layers=4, n_heads=8, n_kv_heads=2, d_ff=1024,
+        vocab_size=4096, max_seq_len=320,
+        num_requests=40,
+        num_slots=4, block_size=16, num_blocks=81,    # 80 usable
+        host_tier_bytes=4_000_000,                    # ~120 wire blocks
+        publisher_num_blocks=17,                      # 16 usable: churn
+        publisher_host_tier_bytes=400_000,            # ~12 blocks: spill
+        disk_tier_bytes=1 << 23,
+        max_request_len=288, prefill_chunk=64,
+        num_docs=4, doc_len=192, tail_lo=8, tail_hi=24,
+        new_lo=16, new_hi=48, publisher_new=8,
+        mean_interarrival_s=0.02, seed=0,
+    )
+
+
 def sharded_smoke_settings() -> dict:
     """Seconds-fast tensor-parallel path (CI, tests/test_serving.py):
     the long-prompt/decode-mix trace shape on a 1-layer MHA model
@@ -747,6 +797,31 @@ def build_tiered_workload(s: dict):
         max_new = int(rng.integers(s["new_lo"], s["new_hi"] + 1))
         trace.append((f"req{i}", prompt, max_new, t))
     return trace, s["num_requests"] * s["prefix_len"]
+
+
+def build_fabric_workload(s: dict):
+    """Long-document corpus: ``num_docs`` shared ``doc_len``-token
+    documents (the retrieval-context / long-system-prompt traffic
+    shape); every request opens with one of them followed by a private
+    tail.  Returns (documents, trace, total shared-document tokens) —
+    the documents are what the publisher primes and the fabric-on arm
+    fetches across the process boundary."""
+    rng = np.random.default_rng(s["seed"])
+    docs = [rng.integers(0, s["vocab_size"],
+                         s["doc_len"]).astype(np.int32)
+            for _ in range(s["num_docs"])]
+    trace = []
+    t = 0.0
+    for i in range(s["num_requests"]):
+        t += float(rng.exponential(s["mean_interarrival_s"]))
+        doc = docs[int(rng.integers(s["num_docs"]))]
+        tail = rng.integers(
+            0, s["vocab_size"],
+            int(rng.integers(s["tail_lo"], s["tail_hi"] + 1)))
+        prompt = np.concatenate([doc, tail]).astype(np.int32)
+        max_new = int(rng.integers(s["new_lo"], s["new_hi"] + 1))
+        trace.append((f"req{i}", prompt, max_new, t))
+    return docs, trace, s["num_requests"] * s["doc_len"]
 
 
 def build_mixed_workload(s: dict):
@@ -1063,7 +1138,9 @@ def run_continuous(params, config, s: dict, trace,
                    mixed_prefill_budget=None,
                    autotune: bool = False,
                    admission_ring: int = 0,
-                   spec_loop: bool = True) -> dict:
+                   spec_loop: bool = True,
+                   disk_tier_bytes=None, disk_tier_path=None,
+                   preload=None) -> dict:
     from kubeshare_tpu.serving import EngineConfig, Request, ServingEngine
 
     mesh_spec = None
@@ -1086,7 +1163,9 @@ def run_continuous(params, config, s: dict, trace,
         steps_per_launch=steps_per_launch,
         autotune=autotune,
         autotune_interval=s.get("autotune_interval", 32),
-        admission_ring=admission_ring),
+        admission_ring=admission_ring,
+        disk_tier_bytes=disk_tier_bytes,
+        disk_tier_path=disk_tier_path),
         tenants=registry)
     if not spec_loop:
         # v1-loop reference arm (the loop-v2 suite's bracket): disarm
@@ -1097,6 +1176,12 @@ def run_continuous(params, config, s: dict, trace,
         engine._spec_loops = {}
     engine.warmup()
     compiles_before = engine.compile_counts()
+    if preload is not None:
+        # fabric arm: remote chains adopted into the host tier BEFORE
+        # the clock starts (a replica pre-warming off the fleet's
+        # prefix bus).  Runs after the compile snapshot on purpose —
+        # adoption is host-side bookkeeping and may not compile
+        preload(engine)
 
     start = time.monotonic()
     pending = list(trace)
@@ -1269,6 +1354,39 @@ def run_continuous(params, config, s: dict, trace,
             "promotion_stall_s": float(metric[
                 ("kubeshare_serving_tier_promotion_stall_seconds_total",
                  ())]),
+        },
+        # fabric/disk observability (all-zero without the tiers): the
+        # remote-vs-local tier-hit split and the disk arena counters,
+        # read off the same scrape surface
+        "tier_hit_origin": {
+            "local": int(_metric_value(
+                metric,
+                "kubeshare_serving_tier_hit_origin_requests_total",
+                origin="local")),
+            "remote": int(_metric_value(
+                metric,
+                "kubeshare_serving_tier_hit_origin_requests_total",
+                origin="remote")),
+        },
+        "disk": {
+            "demoted": int(_metric_value(
+                metric, "kubeshare_serving_disk_tier_blocks_total",
+                event="demoted")),
+            "promoted": int(_metric_value(
+                metric, "kubeshare_serving_disk_tier_blocks_total",
+                event="promoted")),
+            "evicted": int(_metric_value(
+                metric, "kubeshare_serving_disk_tier_blocks_total",
+                event="evicted")),
+            "refused": int(_metric_value(
+                metric, "kubeshare_serving_disk_tier_blocks_total",
+                event="refused")),
+            "corrupt_read": int(_metric_value(
+                metric, "kubeshare_serving_disk_tier_blocks_total",
+                event="corrupt_read")),
+            "bytes_used": int(_metric_value(
+                metric, "kubeshare_serving_disk_tier_bytes",
+                kind="used")),
         },
         "preemptions": preemptions,
         "recompiles": recompiles,
@@ -2535,6 +2653,246 @@ def run_tiered_bench(s: dict, aba: bool = True) -> dict:
     }
 
 
+def _serve_store_subprocess(store_path: str):
+    """Spawn the prefix-store server as a genuinely separate PROCESS
+    on a plain Python + numpy footprint: the child assembles a stub
+    package skeleton and file-loads promtext/kv_tier/fabric directly,
+    so the serving package __init__ (and jax behind it) never imports
+    — asserted in the child.  Returns (proc, port); the server prints
+    ``PORT <n>`` and then answers one connection's fetches."""
+    import subprocess
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = (
+        "import importlib.util, sys, types\n"
+        "root, store = sys.argv[1], sys.argv[2]\n"
+        "for name in ('kubeshare_tpu', 'kubeshare_tpu.utils',\n"
+        "             'kubeshare_tpu.serving'):\n"
+        "    pkg = types.ModuleType(name)\n"
+        "    pkg.__path__ = [root + '/' + name.replace('.', '/')]\n"
+        "    sys.modules[name] = pkg\n"
+        "for name in ('kubeshare_tpu.utils.promtext',\n"
+        "             'kubeshare_tpu.serving.kv_tier',\n"
+        "             'kubeshare_tpu.serving.fabric'):\n"
+        "    path = root + '/' + name.replace('.', '/') + '.py'\n"
+        "    spec = importlib.util.spec_from_file_location(name, path)\n"
+        "    mod = importlib.util.module_from_spec(spec)\n"
+        "    sys.modules[name] = mod\n"
+        "    spec.loader.exec_module(mod)\n"
+        "assert 'jax' not in sys.modules, 'store server pulled in jax'\n"
+        "sys.modules['kubeshare_tpu.serving.fabric']"
+        ".serve_prefix_store(store)\n")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code, root, store_path],
+        stdout=subprocess.PIPE, text=True)
+    line = proc.stdout.readline()
+    if not line.startswith("PORT "):
+        proc.kill()
+        raise RuntimeError(f"prefix-store server never bound: {line!r}")
+    return proc, int(line.split()[1])
+
+
+def run_fabric_bench(s: dict, aba: bool = True) -> dict:
+    """Cluster KV fabric: cold prefixes promoted from DISK across a
+    PROCESS boundary vs paying the cold prefill, at equal device KV
+    budget:
+
+    - **publish**: a publisher engine with a deliberately tiny pool +
+      host tier primes the document corpus; the demotion cascade parks
+      it on the mmap disk arena; ``export_prefix_store`` snapshots the
+      trie (disk/host payloads preferred, live device blocks
+      serialized on the fly) into one store file, served over TCP by a
+      jax-free child process;
+    - **fabric_off_a / fabric_off_b**: the cold engine, no adoption —
+      the ABA bracket (docs/perf.md methodology); the first touch of
+      every document pays its full prefill;
+    - **fabric_on**: the SAME cold geometry, but before the first
+      arrival a :class:`PrefixStoreClient` fetches every document's
+      chain across the process boundary and ``adopt_into`` grafts it
+      host-resident with ``origin="remote"`` — first touches become
+      remote-origin tier hits.
+
+    Headline: the fabric-on arm's prefix-hit (skipped-token) rate vs
+    off and the remote-origin tier-hit split — with every stream
+    hard-asserted identical across arms and zero recompiles after
+    warmup.  ``aba=False`` drops the second bracketing run (tests lock
+    mechanics, not timing)."""
+    import tempfile
+
+    from kubeshare_tpu.serving import (EngineConfig, PrefixStoreClient,
+                                       Request, ServingEngine,
+                                       export_prefix_store)
+    from kubeshare_tpu.serving.fabric import prefix_fabric_key
+    from kubeshare_tpu.serving.kv_tier import adopt_into
+
+    config, params = _bench_model(s)
+    docs, trace, shared_tokens = build_fabric_workload(s)
+
+    workdir = tempfile.mkdtemp(prefix="kvfabric-")
+    arena_path = os.path.join(workdir, "publisher.kvdisk")
+    store_path = os.path.join(workdir, "prefixes.kvps")
+
+    # --- publish: prime the corpus through the cascade, snapshot it
+    publisher = ServingEngine(params, config, EngineConfig(
+        num_slots=1, block_size=s["block_size"],
+        num_blocks=s["publisher_num_blocks"],
+        max_request_len=s["max_request_len"],
+        prefill_chunk=s["prefill_chunk"],
+        host_tier_bytes=s["publisher_host_tier_bytes"],
+        disk_tier_bytes=s["disk_tier_bytes"],
+        disk_tier_path=arena_path))
+    publisher.warmup()
+    for i, doc in enumerate(docs):
+        publisher.submit(Request(f"pub{i}", doc, s["publisher_new"]))
+        publisher.run()
+        publisher.pop_finished()
+    pub_metric = {(sm.name, tuple(sorted(sm.labels.items()))): sm.value
+                  for f in publisher.collect_metrics()
+                  for sm in f.samples}
+    disk_demoted = int(_metric_value(
+        pub_metric, "kubeshare_serving_disk_tier_blocks_total",
+        event="demoted"))
+    if disk_demoted <= 0:
+        raise RuntimeError(
+            "publisher cascade never reached the disk arena — the "
+            "cross-process promotion would not be exercising the "
+            "disk tier")
+
+    def payload_of(node):
+        if node.host_key is not None:
+            e = publisher.host_tier.probe(node.host_key)
+            return None if e is None else e.payload
+        if node.disk_key is not None:
+            return publisher.disk_tier.read(node.disk_key)
+        if node.block is not None and node.block >= 0:
+            return publisher._read_block_payload(node)
+        return None
+
+    manifest = export_prefix_store(publisher.prefix_index, payload_of,
+                                   store_path)
+    if not manifest:
+        raise RuntimeError("publisher exported an empty prefix store")
+    store_bytes = os.path.getsize(store_path)
+
+    # --- serve it from another process, adopt into the fabric-on arm
+    proc, port = _serve_store_subprocess(store_path)
+    fetch_stats = {}
+
+    def preload(engine):
+        client = PrefixStoreClient(port)
+        adopted_tokens = 0
+        adopted_blocks = 0
+        try:
+            for doc in docs:
+                aligned = (len(doc) // s["block_size"]) \
+                    * s["block_size"]
+                if not aligned:
+                    continue
+                chain = client.fetch(
+                    prefix_fabric_key(doc[:aligned]))
+                if not chain:
+                    raise RuntimeError(
+                        "store returned no chain for a published "
+                        "document — the manifest and the corpus "
+                        "disagree")
+                for ctoks, payload in chain:
+                    if adopt_into(engine.host_tier,
+                                  engine.prefix_index, ctoks, payload,
+                                  None, origin="remote") is not None:
+                        adopted_blocks += 1
+                matched = engine.prefix_match_len(doc[:aligned])
+                adopted_tokens += int(matched)
+        finally:
+            fetch_stats.update(
+                fetches=client.fetches, retries=client.retries,
+                bytes_fetched=client.bytes_total,
+                adopted_blocks=adopted_blocks,
+                adopted_tokens=adopted_tokens)
+            client.close()
+
+    cold = dict(host_tier_bytes=s["host_tier_bytes"],
+                disk_tier_bytes=s["disk_tier_bytes"])
+    off_a = run_continuous(params, config, s, trace, **cold)
+    on = run_continuous(params, config, s, trace, preload=preload,
+                        **cold)
+    off_b = run_continuous(params, config, s, trace, **cold) \
+        if aba else off_a
+    proc.stdout.close()
+    proc.wait(timeout=30)
+    publisher.disk_tier.close()
+    import shutil
+    shutil.rmtree(workdir, ignore_errors=True)
+
+    recompiles = (off_a.pop("recompiles") + on.pop("recompiles")
+                  + (off_b.pop("recompiles") if aba else 0))
+    if recompiles:
+        raise RuntimeError(
+            f"{recompiles} recompilations after warmup — a static-shape "
+            f"leak; the comparison (and a TPU serving pod) is invalid")
+    # fabric correctness end to end: bytes that crossed a disk arena, a
+    # store file, and a process boundary may not change ONE token of
+    # any stream
+    arms = {"fabric_off_a": off_a, "fabric_on": on}
+    if aba:
+        arms["fabric_off_b"] = off_b
+    mismatched = [
+        (name, rid) for name, arm in arms.items() if name != "fabric_on"
+        for rid in on["requests"]
+        if on["requests"][rid]["tokens"]
+        != arm["requests"][rid]["tokens"]]
+    if mismatched:
+        raise RuntimeError(
+            f"streams diverged vs the fabric-on arm for {mismatched} — "
+            f"remote promotion is NOT bit-exact")
+    if on["tier_hit_origin"]["remote"] <= 0:
+        raise RuntimeError(
+            "fabric-on arm served zero remote-origin tier hits — the "
+            "adopted chains never promoted")
+    for arm in arms.values():
+        arm.pop("requests", None)
+    # off_b IS off_a when aba=False, so the plain mean covers both modes
+    off_hit = (off_a["prefix_hit_tokens"]
+               + off_b["prefix_hit_tokens"]) / 2
+    off_ttft = (off_a["ttft_s"]["p50"] + off_b["ttft_s"]["p50"]) / 2
+    off_tps = (off_a["tokens_per_s"] + off_b["tokens_per_s"]) / 2
+    hit_rate_off = off_hit / max(1, shared_tokens)
+    hit_rate_on = on["prefix_hit_tokens"] / max(1, shared_tokens)
+    return {
+        "suite": "serving-fabric",
+        "metric": "prefix-hit (skipped-token) rate with cold documents "
+                  "promoted from the publisher's disk arena across a "
+                  "process boundary before the first arrival, vs the "
+                  "same cold engine paying first-touch prefills "
+                  "(ABA-bracketed, equal device KV budget)",
+        "settings": {k: v for k, v in s.items()},
+        "shared_document_tokens": shared_tokens,
+        "store": {
+            "chains": len(manifest),
+            "bytes": store_bytes,
+            "publisher_disk_demoted": disk_demoted,
+            "publisher_disk_bytes_used": int(_metric_value(
+                pub_metric, "kubeshare_serving_disk_tier_bytes",
+                kind="used")),
+        },
+        "fetch": dict(fetch_stats),
+        "fabric_on": on,
+        "fabric_off_first": off_a,
+        "fabric_off_last": off_b,
+        "fabric_off": {"tokens_per_s": off_tps,
+                       "ttft_p50_s": off_ttft,
+                       "prefix_hit_tokens": off_hit},
+        "hit_rate": {"fabric_off": hit_rate_off,
+                     "fabric_on": hit_rate_on},
+        "remote_tier_hits": on["tier_hit_origin"]["remote"],
+        "ttft_p50_ratio": off_ttft
+        / max(1e-9, on["ttft_s"]["p50"]),
+        "tokens_per_s_ratio": on["tokens_per_s"]
+        / max(1e-9, off_tps),
+        "streams_bit_exact": True,
+        "recompiles_after_warmup": recompiles,
+        "platform": jax.default_backend(),
+    }
+
+
 def run_sharded_bench(s: dict, aba: bool = True) -> dict:
     """Tensor-parallel sharded serving vs the single-device engine on
     one long-prompt/decode-mix trace at equal PER-DEVICE KV-HBM
@@ -2787,6 +3145,15 @@ def main() -> None:
                              "for the verify-in-loop + admission-ring "
                              "suite (v2 vs v1 loop vs K=1 on an echoed "
                              "phrase-pool trace)")
+    parser.add_argument("--fabric", action="store_true",
+                        help="cluster KV fabric: cold documents "
+                             "promoted from a publisher's disk arena "
+                             "across a process boundary (jax-free "
+                             "store server) vs paying first-touch "
+                             "prefills, ABA-bracketed at equal device "
+                             "KV budget (streams hard-asserted "
+                             "identical; cold-start prefix-hit rate "
+                             "and remote tier-hit headline)")
     parser.add_argument("--fleet", action="store_true",
                         help="replica fleet: prefix-affinity routing vs "
                              "round-robin at equal aggregate KV budget "
@@ -2829,6 +3196,9 @@ def main() -> None:
         result = run_autotune_bench(
             autotune_smoke_settings() if args.smoke
             else autotune_settings())
+    elif args.fabric:
+        result = run_fabric_bench(
+            fabric_smoke_settings() if args.smoke else fabric_settings())
     elif args.fleet:
         result = run_fleet_bench(
             fleet_smoke_settings() if args.smoke else fleet_settings())
@@ -2905,6 +3275,26 @@ def main() -> None:
               f"decisions {result['tuner_decisions']}; streams "
               f"bit-exact; zero recompiles in every arm",
               file=sys.stderr)
+        return
+    if args.fabric:
+        st, fe, hr = result["store"], result["fetch"], result["hit_rate"]
+        print(f"\ncluster KV fabric ({st['chains']} chains / "
+              f"{st['bytes']} store bytes published off a disk arena "
+              f"holding {st['publisher_disk_demoted']} demoted blocks, "
+              f"served by a jax-free child process): "
+              f"{fe['adopted_blocks']} blocks "
+              f"({fe['adopted_tokens']} document tokens) fetched over "
+              f"TCP in {fe['fetches']} fetches / "
+              f"{fe['bytes_fetched']} bytes and adopted "
+              f"origin=remote; cold-start prefix-hit rate "
+              f"{100 * hr['fabric_on']:.1f}% fabric-on vs "
+              f"{100 * hr['fabric_off']:.1f}% fabric-off "
+              f"(ABA-bracketed, equal device KV budget); "
+              f"{result['remote_tier_hits']} remote-origin tier hits; "
+              f"TTFT p50 ratio {result['ttft_p50_ratio']:.2f}x; "
+              f"tokens/s ratio {result['tokens_per_s_ratio']:.3f}; "
+              f"streams bit-exact across all arms; zero recompiles "
+              f"after warmup", file=sys.stderr)
         return
     if args.fleet:
         on, rr = result["affinity"], result["round_robin"]

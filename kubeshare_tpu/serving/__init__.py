@@ -66,6 +66,15 @@ The serving subsystem the fractional-chip runtime was built to host:
   through the shared host tier so survivors inherit their caches —
   streams bit-exact with one monolithic engine at equal aggregate KV
   budget;
+- :mod:`fabric` — the cluster KV fabric: a versioned, crc-framed
+  message envelope over pluggable transports (in-process loopback,
+  length-prefixed sockets), at-least-once :class:`FabricEndpoint`
+  delivery (ack/dedup/TTL/bounded-backoff redelivery), a
+  :class:`FabricDirectory` mapping prefix keys to owning replicas so a
+  trie miss resolves to a remote promotion instead of a re-prefill,
+  and an exportable prefix store serving cold prefixes across a
+  process boundary — migration tickets, crash salvage, drain
+  inheritance, and tier chains all ride this one bus;
 - :mod:`metrics_view` — shared PromQL-style readers over the metrics
   plane: per-consumer interval windows over cumulative counters and
   histogram buckets (``increase()``), quantile estimation
@@ -93,6 +102,12 @@ from .disagg import (DecodePool, DisaggRouter, DisaggTopology, KVMigrator,
 from .drafter import NGramDrafter
 from .engine import (EngineConfig, Request, RequestResult, ServingEngine,
                      plan_prefill_chunks)
+from .fabric import (FabricDirectory, FabricEndpoint, FabricTransport,
+                     LoopbackTransport, PrefixStoreClient, SocketTransport,
+                     export_prefix_store, fabric_metric_families,
+                     load_prefix_store, pack_message, pack_ticket,
+                     prefix_fabric_key, recv_frame, send_frame,
+                     serve_prefix_store, unpack_message, unpack_ticket)
 from .fleet import (PrefixAffinityPolicy, ReplicaFleet, ReplicaHandle,
                     RoundRobinPolicy, RoutingPolicy, ScalingPolicy,
                     TTFTBreachPolicy)
@@ -101,9 +116,9 @@ from .kv_blocks import (BlockAllocator, BlockExhausted, PagedKVPool,
 from .metrics_view import (CounterWindow, HistogramWindow, flatten_metrics,
                            hist_quantile, interval_quantile,
                            metric_histogram, metric_value)
-from .kv_tier import (KV_CHAIN_VERSION, KV_WIRE_VERSION, HostTier,
+from .kv_tier import (KV_CHAIN_VERSION, KV_WIRE_VERSION, DiskTier, HostTier,
                       LRUTierPolicy, QoSTierPolicy, TierPolicy,
-                      WireCorruption, pack_block,
+                      WireCorruption, adopt_into, pack_block,
                       pack_chain, unpack_block, unpack_chain,
                       wire_block_bytes)
 from .paged import (paged_copy_block, paged_decode_loop, paged_decode_span,
@@ -128,7 +143,11 @@ __all__ = [
     "DecodePool",
     "DisaggRouter",
     "DisaggTopology",
+    "DiskTier",
     "EngineConfig",
+    "FabricDirectory",
+    "FabricEndpoint",
+    "FabricTransport",
     "FairQueue",
     "FaultClock",
     "FaultPlan",
@@ -142,9 +161,11 @@ __all__ = [
     "KnobSpec",
     "KnobView",
     "LRUTierPolicy",
+    "LoopbackTransport",
     "NGramDrafter",
     "PagedKVPool",
     "PrefillPool",
+    "PrefixStoreClient",
     "PrefixAffinityPolicy",
     "PrefixIndex",
     "QoSTierPolicy",
@@ -164,20 +185,27 @@ __all__ = [
     "ServingEngine",
     "ShardDecision",
     "ShardedServingContext",
+    "SocketTransport",
     "TTFTBreachPolicy",
     "TenantRegistry",
     "TenantSpec",
     "TuningPolicy",
+    "adopt_into",
     "carve_replica_groups",
     "chain_token_runs",
+    "export_prefix_store",
+    "fabric_metric_families",
     "flatten_metrics",
     "hist_quantile",
     "init_paged_pool",
     "interval_quantile",
+    "load_prefix_store",
     "metric_histogram",
     "metric_value",
     "pack_block",
     "pack_chain",
+    "pack_message",
+    "pack_ticket",
     "paged_copy_block",
     "paged_decode_loop",
     "paged_decode_span",
@@ -190,8 +218,14 @@ __all__ = [
     "paged_verify_span",
     "plan_prefill_chunks",
     "plan_sharding",
+    "prefix_fabric_key",
+    "recv_frame",
+    "send_frame",
+    "serve_prefix_store",
     "serving_sharding_rules",
     "unpack_block",
     "unpack_chain",
+    "unpack_message",
+    "unpack_ticket",
     "wire_block_bytes",
 ]

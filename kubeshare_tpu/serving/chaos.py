@@ -24,7 +24,14 @@ seams the serving stack already consults:
   migration delivery attempt — a dropped ticket exercises the
   TTL/backoff retry path;
 - ``TokenClient`` consults ``on_tokend_request`` before each wire
-  round-trip — a refusal exercises the bounded-backoff retry.
+  round-trip — a refusal exercises the bounded-backoff retry;
+- ``FabricTransport`` routes every transmitted frame through
+  ``on_fabric_transmit`` — a planned fault drops, duplicates, reorders
+  or bit-flips the frame in flight, and the fabric's per-message crc +
+  ack/redelivery contract must absorb it;
+- ``DiskTier`` routes every payload read through ``on_disk_read`` — a
+  planned corruption models a rotten sector, which the wire-v2 block
+  crc must catch before the bytes reach a device upload.
 
 No monkeypatching anywhere: every seam is an attribute the component
 owns (default ``None`` — zero overhead off the chaos path), so a chaos
@@ -77,6 +84,11 @@ class FaultPlan:
         self.tier_corruptions: Set[int] = set()
         self.ticket_drops: Set[int] = set()
         self.tokend_refusals: Set[int] = set()
+        self.fabric_drops: Set[int] = set()
+        self.fabric_duplicates: Set[int] = set()
+        self.fabric_reorders: Set[int] = set()
+        self.fabric_corruptions: Set[int] = set()
+        self.disk_corruptions: Set[int] = set()
 
     # -- builders ------------------------------------------------------
     def kill(self, label: str, at_step: int) -> "FaultPlan":
@@ -126,6 +138,53 @@ class FaultPlan:
         self.tokend_refusals.add(int(ordinal))
         return self
 
+    def drop_fabric(self, ordinal: int) -> "FaultPlan":
+        """Drop the ``ordinal``-th fabric frame in flight (a lost
+        datagram; the sender's TTL/backoff redelivery must recover
+        it — or its expiry must surface through ``take_expired``).
+        Ordinals count EVERY transmitted frame, acks and redeliveries
+        included, in transmit order."""
+        if ordinal < 0:
+            raise ValueError(f"ordinal must be >= 0, got {ordinal}")
+        self.fabric_drops.add(int(ordinal))
+        return self
+
+    def duplicate_fabric(self, ordinal: int) -> "FaultPlan":
+        """Deliver the ``ordinal``-th fabric frame twice (a retransmit
+        race; the receiver's (src, msg_id) dedup must absorb the
+        second copy)."""
+        if ordinal < 0:
+            raise ValueError(f"ordinal must be >= 0, got {ordinal}")
+        self.fabric_duplicates.add(int(ordinal))
+        return self
+
+    def reorder_fabric(self, ordinal: int) -> "FaultPlan":
+        """Deliver the ``ordinal``-th fabric frame at the FRONT of the
+        destination queue (it overtakes everything already in flight —
+        only meaningful on the loopback transport; sockets are FIFO)."""
+        if ordinal < 0:
+            raise ValueError(f"ordinal must be >= 0, got {ordinal}")
+        self.fabric_reorders.add(int(ordinal))
+        return self
+
+    def corrupt_fabric(self, ordinal: int) -> "FaultPlan":
+        """Flip one seeded bit in the ``ordinal``-th fabric frame in
+        flight (line noise; the per-message crc must reject the frame
+        and redelivery must carry the clean copy)."""
+        if ordinal < 0:
+            raise ValueError(f"ordinal must be >= 0, got {ordinal}")
+        self.fabric_corruptions.add(int(ordinal))
+        return self
+
+    def corrupt_disk_read(self, ordinal: int) -> "FaultPlan":
+        """Flip one seeded bit in the payload returned by the
+        ``ordinal``-th disk-tier read (a rotten sector under the mmap;
+        the wire-v2 block crc must catch it before promotion)."""
+        if ordinal < 0:
+            raise ValueError(f"ordinal must be >= 0, got {ordinal}")
+        self.disk_corruptions.add(int(ordinal))
+        return self
+
 
 class FaultClock:
     """The runtime half of a chaos run: counts each seam's ordinals,
@@ -151,6 +210,8 @@ class FaultClock:
         self._puts = 0
         self._deliveries = 0
         self._tokend = 0
+        self._fabric_frames = 0
+        self._disk_reads = 0
         self.events: List[Tuple] = []
 
     # -- the virtual clock ---------------------------------------------
@@ -235,3 +296,45 @@ class FaultClock:
             self.events.append(("refuse_tokend", n, verb))
             return True
         return False
+
+    def on_fabric_transmit(self, frame: bytes) -> List[Tuple[bytes, bool]]:
+        """Consulted by a ``FabricTransport`` per transmitted frame:
+        returns the DELIVERIES the plan decides on, each a
+        ``(frame, front)`` pair where ``front`` asks for front-of-queue
+        insertion (reorder).  ``[]`` drops the frame, two entries
+        duplicate it, a mutated frame models line corruption (length
+        preserved; the fabric envelope crc must catch it)."""
+        n = self._fabric_frames
+        self._fabric_frames = n + 1
+        if n in self.plan.fabric_drops:
+            self.events.append(("drop_fabric", n))
+            return []
+        if n in self.plan.fabric_corruptions and frame:
+            bit = (zlib.crc32(f"{self.plan.seed}:fabric:{n}".encode())
+                   % (len(frame) * 8))
+            buf = bytearray(frame)
+            buf[bit // 8] ^= 1 << (bit % 8)
+            self.events.append(("corrupt_fabric", n, bit))
+            return [(bytes(buf), False)]
+        if n in self.plan.fabric_duplicates:
+            self.events.append(("duplicate_fabric", n))
+            return [(frame, False), (frame, False)]
+        if n in self.plan.fabric_reorders:
+            self.events.append(("reorder_fabric", n))
+            return [(frame, True)]
+        return [(frame, False)]
+
+    def on_disk_read(self, payload: bytes) -> bytes:
+        """Consulted by ``DiskTier`` per payload read: a planned
+        corruption flips one seeded bit (rotten sector; length
+        preserved — only the block crc catches the damage)."""
+        n = self._disk_reads
+        self._disk_reads = n + 1
+        if n not in self.plan.disk_corruptions or not payload:
+            return payload
+        bit = (zlib.crc32(f"{self.plan.seed}:disk:{n}".encode())
+               % (len(payload) * 8))
+        buf = bytearray(payload)
+        buf[bit // 8] ^= 1 << (bit % 8)
+        self.events.append(("corrupt_disk_read", n, bit))
+        return bytes(buf)

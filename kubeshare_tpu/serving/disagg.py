@@ -76,6 +76,8 @@ from ..parallel.distributed import (MultisliceSpec, multislice_spec_from_env,
                                     slice_device_mesh)
 from ..utils.promtext import MetricFamily, Sample
 from .autotune import AutoTuner
+from .fabric import (FabricEndpoint, FabricTransport, K_TICKET,
+                     fabric_metric_families, pack_ticket, unpack_ticket)
 from .engine import (EngineConfig, Request, RequestResult, ServingEngine,
                      _Pending, _histogram_samples, _bucket_observe,
                      plan_prefill_chunks)
@@ -463,6 +465,8 @@ class DisaggRouter:
         handoff_ttl_steps: Optional[int] = None,
         handoff_backoff_steps: int = 1,
         handoff_backoff_cap_steps: int = 8,
+        fabric: Optional[FabricTransport] = None,
+        fabric_ttl_ticks: int = 16,
     ) -> None:
         if handoff_ttl_steps is not None and handoff_ttl_steps < 1:
             raise ValueError(
@@ -561,6 +565,33 @@ class DisaggRouter:
         self._handoff_ttl = handoff_ttl_steps
         self._handoff_backoff = handoff_backoff_steps
         self._handoff_backoff_cap = handoff_backoff_cap_steps
+        # handoffs over the cluster KV fabric (serving/fabric.py): a
+        # packed ticket becomes a K_TICKET message from the prefill
+        # endpoint to the decode endpoint — per-message crc, TTL,
+        # bounded-backoff redelivery, receiver dedup.  Transport-level
+        # faults (drop/duplicate/corrupt) are the fabric's problem;
+        # decode CAPACITY retries keep the legacy backoff discipline,
+        # applied to the arrival queue instead of the send queue.
+        self._fabric_pf: Optional[FabricEndpoint] = None
+        self._fabric_dc: Optional[FabricEndpoint] = None
+        self._fabric_inflight: Dict[int, _Ticket] = {}
+        self._fabric_arrivals: List[_Ticket] = []
+        self._fabric_expired_rids: set = set()
+        self._fabric_tick_step = -1
+        if fabric is not None:
+            if fabric_ttl_ticks < 1:
+                raise ValueError(
+                    f"fabric_ttl_ticks must be >= 1, got "
+                    f"{fabric_ttl_ticks}")
+            tag = replica_label or "dg"
+            self._fabric_pf = FabricEndpoint(
+                f"{tag}-pf", fabric, ttl_ticks=fabric_ttl_ticks,
+                backoff_base=handoff_backoff_steps,
+                backoff_cap=handoff_backoff_cap_steps)
+            self._fabric_dc = FabricEndpoint(
+                f"{tag}-dc", fabric, ttl_ticks=fabric_ttl_ticks,
+                backoff_base=handoff_backoff_steps,
+                backoff_cap=handoff_backoff_cap_steps)
         self._steps = 0
         self.handoff_retries: Dict[str, int] = {
             "delivered": 0, "retried": 0, "expired": 0, "corrupt": 0,
@@ -600,7 +631,7 @@ class DisaggRouter:
                              for s in self.prefill._slots)
                 free_d = sum(s.state == "free"
                              for s in self.decode._slots)
-                return (staged + len(self._tickets)
+                return (staged + self._pending_handoffs()
                         < min(self._max_pending_handoffs, free_d))
             self.prefill.admission_gate = gate
         # router-level autotuner (serving/autotune.py): retunes the
@@ -682,7 +713,8 @@ class DisaggRouter:
         self._stage_settled = {
             s.rid: (s.plan[0][0] if s.plan else s.prompt.size)
             for s in self.prefill._slots if s.state == "prefill"}
-        if self._tickets and not worked and self._handoff_ttl is None:
+        if self._tickets and not worked and self._handoff_ttl is None \
+                and self._fabric_pf is None:
             # nothing moved anywhere yet a ticket is stuck: with the
             # decode pool fully idle its reservation can never succeed
             # (submit() pre-checked sizing, so this is state corruption
@@ -694,7 +726,14 @@ class DisaggRouter:
                 f"migration deadlock: {len(self._tickets)} ticket(s) "
                 f"undeliverable with both pools idle (head: "
                 f"{self._tickets[0].rid!r})")
-        return worked or bool(self._tickets)
+        if self._fabric_pf is not None and self._fabric_arrivals \
+                and not worked and self._handoff_ttl is None \
+                and not self._fabric_inflight and not self._tickets:
+            raise RuntimeError(
+                f"migration deadlock: {len(self._fabric_arrivals)} "
+                f"fabric-delivered ticket(s) unadmittable with both "
+                f"pools idle (head: {self._fabric_arrivals[0].rid!r})")
+        return worked or self._pending_handoffs() > 0
 
     def run(self) -> Dict[str, RequestResult]:
         """Drain everything; returns results by request id."""
@@ -709,9 +748,17 @@ class DisaggRouter:
                     eng.guard.finish()
         return dict(self._results)
 
+    def _pending_handoffs(self) -> int:
+        """Every undelivered handoff, wherever it currently sits: the
+        local ticket queue, the fabric's unacked in-flight map, and the
+        decode-side arrival queue — the admission gate's decode-reserve
+        count and the idle test both need all three."""
+        return (len(self._tickets) + len(self._fabric_inflight)
+                + len(self._fabric_arrivals))
+
     @property
     def idle(self) -> bool:
-        return (not self._tickets and self.prefill.idle
+        return (self._pending_handoffs() == 0 and self.prefill.idle
                 and self.decode.idle)
 
     def result(self, rid: str) -> RequestResult:
@@ -741,7 +788,7 @@ class DisaggRouter:
         p = self.prefill.load_probe()
         d = self.decode.load_probe()
         return {
-            "queue_depth": p["queue_depth"] + len(self._tickets),
+            "queue_depth": p["queue_depth"] + self._pending_handoffs(),
             "free_slots": min(p["free_slots"], d["free_slots"]),
             "free_blocks": p["free_blocks"] + d["free_blocks"],
         }
@@ -818,8 +865,12 @@ class DisaggRouter:
             "failed, stream re-queued to re-prefill)", "counter")
         for outcome, n in sorted(self.handoff_retries.items()):
             retries.add({"outcome": outcome}, n)
-        return (list(merged.values()) + self.migrator.collect_metrics()
-                + [retries])
+        out = (list(merged.values()) + self.migrator.collect_metrics()
+               + [retries])
+        if self._fabric_pf is not None:
+            out.extend(fabric_metric_families(
+                [self._fabric_pf, self._fabric_dc]))
+        return out
 
     @staticmethod
     def _merge_samples(dst: MetricFamily, src: MetricFamily) -> None:
@@ -851,6 +902,8 @@ class DisaggRouter:
         self._tickets.append(ticket)
 
     def _drain_tickets(self) -> bool:
+        if self._fabric_pf is not None:
+            return self._drain_tickets_fabric()
         progressed = False
         now = self._steps
         while self._tickets:
@@ -903,6 +956,126 @@ class DisaggRouter:
             self._set_backoff(ticket, now)
             break
         return progressed
+
+    def _drain_tickets_fabric(self) -> bool:
+        """The handoff path when tickets ride the cluster KV fabric.
+        Four stages, all host work: (1) every freshly packed ticket is
+        serialized (:func:`~kubeshare_tpu.serving.fabric.pack_ticket`)
+        and sent prefill-endpoint → decode-endpoint; (2) the decode
+        endpoint's arrivals are deserialized into tickets (dedup +
+        crc already handled by the endpoint) and queued; (3) acks
+        retire the in-flight map, the per-step tick drives redelivery,
+        and TTL expiries resume their streams through the done=1
+        contract; (4) the arrival queue drains under the LEGACY
+        capacity discipline — deliver, Guarantee preemption, bounded
+        backoff — so a full decode pool behaves exactly as it did
+        before the fabric existed."""
+        progressed = False
+        now = self._steps
+        while self._tickets:
+            t = self._tickets.pop(0)
+            hint = np.asarray(
+                t.hint if t.hint is not None else [], np.int32)
+            body = pack_ticket(
+                t.rid, t.tenant, t.prompt, t.first_token, t.max_new,
+                t.temperature,
+                np.asarray(t.step_keys, np.uint32),
+                t.payload, t.emitted_prefix, hint, t.pack_stall_s,
+                t.last_token_at)
+            mid = self._fabric_pf.send(self._fabric_dc.name, K_TICKET,
+                                       body)
+            self._fabric_inflight[mid] = t
+            progressed = True
+        for src, kind, mid, body in self._fabric_dc.poll():
+            if kind != K_TICKET:
+                continue
+            d = unpack_ticket(body)
+            if d["rid"] in self._fabric_expired_rids:
+                # the sender already expired this ticket and resumed
+                # the stream via re-prefill; a late frame must not
+                # admit it a second time
+                self._fabric_expired_rids.discard(d["rid"])
+                self.handoff_retries["stale"] = \
+                    self.handoff_retries.get("stale", 0) + 1
+                continue
+            self._fabric_arrivals.append(_Ticket(
+                rid=d["rid"], tenant=d["tenant"], prompt=d["prompt"],
+                first_token=d["first_token"], max_new=d["max_new"],
+                temperature=d["temperature"],
+                step_keys=d["step_keys"], payload=d["payload"],
+                result=self._results.get(d["rid"]),
+                emitted_prefix=list(d["emitted_prefix"]),
+                last_token_at=d["last_token_at"],
+                hint=([int(x) for x in d["hint"]]
+                      if d["hint"].size else None),
+                pack_stall_s=d["pack_stall_s"], created_step=now))
+            progressed = True
+        self._fabric_pf.poll()  # acks
+        for mid in self._fabric_pf.take_delivered():
+            self._fabric_inflight.pop(mid, None)
+        if self._fabric_tick_step != now:
+            # _drain_tickets runs up to three times per router step;
+            # virtual time advances once
+            self._fabric_tick_step = now
+            self._fabric_pf.tick()
+            self._fabric_dc.tick()
+        for dest, kind, mid, body in self._fabric_pf.take_expired():
+            t = self._fabric_inflight.pop(mid, None)
+            if t is None:
+                continue
+            if self._rid_live_decode(t.rid):
+                # the ticket WAS admitted — only its ack died.  Work
+                # happened exactly once; resuming would run it twice.
+                self.handoff_retries["delivered"] += 1
+                continue
+            self._fabric_expired_rids.add(t.rid)
+            self._expire_ticket(t, "expired")
+            progressed = True
+        while self._fabric_arrivals:
+            ticket = self._fabric_arrivals[0]
+            if self._handoff_ttl is not None \
+                    and ticket.attempts > 0 \
+                    and now - ticket.created_step >= self._handoff_ttl:
+                self._fabric_arrivals.pop(0)
+                self._expire_ticket(ticket, "expired")
+                progressed = True
+                continue
+            if ticket.next_attempt_step > now:
+                break
+            try:
+                delivered = self.migrator.deliver(ticket)
+            except WireCorruption:
+                # rot that predates the envelope (a corrupt tier put
+                # packed into the chain): the block crc catches it at
+                # admit, the stream re-prefills from clean state
+                self._fabric_arrivals.pop(0)
+                self._expire_ticket(ticket, "corrupt")
+                progressed = True
+                continue
+            if delivered:
+                self._fabric_arrivals.pop(0)
+                self.handoff_retries["delivered"] += 1
+                progressed = True
+                continue
+            spec = self.decode.tenants.get(ticket.tenant)
+            if spec.is_guarantee and self.decode._preempt_victim():
+                progressed = True
+                continue
+            self.handoff_retries["retried"] += 1
+            self._set_backoff(ticket, now)
+            break
+        return progressed
+
+    def _rid_live_decode(self, rid: str) -> bool:
+        """Did ``rid`` already make it decode-side (admitted slot, or
+        finished)?  The expiry-vs-late-ack tiebreaker: at-least-once
+        delivery plus this check is what keeps a lost ACK from running
+        a stream twice."""
+        if any(s.state != "free" and s.rid == rid
+               for s in self.decode._slots):
+            return True
+        r = self._results.get(rid)
+        return r is not None and r.done
 
     def _set_backoff(self, ticket: _Ticket, now: int) -> None:
         """Bounded exponential backoff in router steps: attempt k waits

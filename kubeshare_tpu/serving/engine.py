@@ -112,7 +112,7 @@ from .autotune import AnalyticPolicy, AutoTuner
 from .drafter import NGramDrafter
 from .kv_blocks import (BlockAllocator, BlockExhausted, QuotaExceeded,
                         init_paged_pool)
-from .kv_tier import (HostTier, LRUTierPolicy, QoSTierPolicy,
+from .kv_tier import (DiskTier, HostTier, LRUTierPolicy, QoSTierPolicy,
                       WireCorruption, pack_block, unpack_block,
                       wire_block_bytes)
 from .paged import (paged_copy_block, paged_decode_loop,
@@ -289,6 +289,19 @@ class EngineConfig:
     # Guarantee-charged host bytes are protected from Opportunistic
     # pressure, Guarantee pressure drains Opportunistic entries first)
     tier_policy: str = "lru"
+    # DISK tier below host RAM (kv_tier.DiskTier): a byte budget for
+    # the mmap-backed arena host-budget evictions cascade into
+    # (HOST→DISK) instead of being destroyed.  Admission stages a
+    # matched disk block back up (DISK→HOST, crc-validated) and the
+    # existing paged_upload_block promotion takes it from there.  None
+    # = off (host evictions destroy, the pre-disk behavior).  Requires
+    # host_tier_bytes — the cascade has to have a tier above it.
+    # Streams are bit-exact either way.
+    disk_tier_bytes: Optional[int] = None
+    # arena file path for the disk tier (None = an anonymous unlinked
+    # tempfile).  A named path is what the fabric bench exports across
+    # the process boundary.
+    disk_tier_path: Optional[str] = None
     # per-step cap on the prefill tokens fused into a mixed dispatch —
     # the bound on the extra latency ANY decode lane (a Guarantee
     # tenant's included) pays per admission ride-along.  A plan chunk
@@ -443,6 +456,18 @@ def _config_rows(ec: EngineConfig, config: TransformerConfig,
         (ec.tier_policy not in ("lru", "qos"),
          f"tier_policy must be 'lru' or 'qos', got "
          f"{ec.tier_policy!r}"),
+        (ec.disk_tier_bytes is not None and ec.host_tier_bytes is None,
+         "disk_tier_bytes requires host_tier_bytes — the disk tier "
+         "is the cascade target of host-budget evictions; there is "
+         "no HOST→DISK demotion without a host tier above it"),
+        (ec.disk_tier_bytes is not None and wire is not None
+         and ec.disk_tier_bytes < wire,
+         f"disk_tier_bytes {ec.disk_tier_bytes} is below one "
+         f"block's wire size ({wire}) — the disk tier could "
+         f"never hold a single block"),
+        (ec.disk_tier_path is not None and ec.disk_tier_bytes is None,
+         "disk_tier_path without disk_tier_bytes — a named arena "
+         "file needs a disk tier to fill it"),
         (ec.draft_len < 1 or bool(ec.draft_len & (ec.draft_len - 1)),
          f"draft_len must be a power of two >= 1, got "
          f"{ec.draft_len} — the adaptive width doubles/halves "
@@ -701,18 +726,24 @@ class ServingEngine:
         # QoS-aware policy reads class membership from it)
         self.tenants = tenants or TenantRegistry.default()
         self.host_tier: Optional[HostTier] = None
+        self.disk_tier: Optional[DiskTier] = None
         if ec.host_tier_bytes is not None:
             # the below-one-block's-wire-size check moved into the
             # _config_rows validation table with the rest
             policy = (LRUTierPolicy() if ec.tier_policy == "lru"
                       else QoSTierPolicy(self.tenants))
             self.host_tier = HostTier(ec.host_tier_bytes, policy,
-                                      on_drop=self._drop_host_entry,
+                                      on_drop=self._spill_host_entry,
                                       ledger_hook=tier_ledger_hook)
             # the index purges a detached host descendant's tier entry
             # through this hook (evict of a device ancestor, displaced
             # leaf upgrades)
             self.prefix_index.host_drop = self.host_tier.forget
+            if ec.disk_tier_bytes is not None:
+                self.disk_tier = DiskTier(ec.disk_tier_bytes,
+                                          path=ec.disk_tier_path,
+                                          on_drop=self._drop_disk_entry)
+                self.prefix_index.disk_drop = self.disk_tier.forget
         elif shared_host_tier is not None:
             # disaggregated mode: the router's one tier sits under BOTH
             # pools' tries (the cross-pool cache bus).  The router owns
@@ -881,6 +912,12 @@ class ServingEngine:
         self.tier_hit_requests = 0
         self.tier_hit_tokens = 0
         self.tier_promotion_stall_s = 0.0
+        # the remote-vs-local split of tier_hit_requests: "remote" when
+        # any payload the admission consumed arrived over the fabric
+        # (a peer's demotion adopted here), "local" otherwise — the
+        # fleet-wide prefix bus's effectiveness signal
+        self.tier_hit_requests_by_origin: Dict[str, int] = {
+            "local": 0, "remote": 0}
         # wire blocks that failed their v2 crc32 on consumption — each
         # was dropped (tier miss / failed delivery) and re-prefilled,
         # never attended into a stream
@@ -1889,6 +1926,48 @@ class ServingEngine:
             "and re-prefilled, never attended into a stream.",
             "counter")
         tier_corrupt.add({}, self.tier_corrupt_blocks)
+        tier_origin = MetricFamily(
+            "kubeshare_serving_tier_hit_origin_requests_total",
+            "Tier-hit admissions split by payload origin: local = "
+            "this engine's own demotions (and drain/salvage "
+            "inheritance), remote = at least one consumed payload "
+            "arrived over the KV fabric.", "counter")
+        for org in ("local", "remote"):
+            tier_origin.add({"origin": org},
+                            self.tier_hit_requests_by_origin[org])
+        disk_bytes = MetricFamily(
+            "kubeshare_serving_disk_tier_bytes",
+            "Disk-tier occupancy vs budget (serialized wire bytes "
+            "live in the mmap arena; fragmentation can grow the file "
+            "past used, never used past budget).", "gauge")
+        disk_bytes.add({"kind": "used"},
+                       self.disk_tier.used_bytes
+                       if self.disk_tier is not None else 0)
+        disk_bytes.add({"kind": "budget"},
+                       self.disk_tier.budget_bytes
+                       if self.disk_tier is not None else 0)
+        disk_blocks = MetricFamily(
+            "kubeshare_serving_disk_tier_blocks_total",
+            "Disk-tier lifetime events: demoted = HOST→DISK cascades "
+            "in, promoted = DISK→HOST stagings out, evicted = "
+            "disk-budget LRU drops, refused = puts that found no "
+            "room, corrupt_read = payloads whose crc32 failed after a "
+            "disk read (dropped, re-prefilled cold).", "counter")
+        if self.disk_tier is not None:
+            disk_blocks.add({"event": "demoted"},
+                            self.disk_tier.stored_blocks)
+            disk_blocks.add({"event": "promoted"},
+                            self.disk_tier.promoted_blocks)
+            disk_blocks.add({"event": "evicted"},
+                            self.disk_tier.evicted_blocks)
+            disk_blocks.add({"event": "refused"},
+                            self.disk_tier.refused_blocks)
+            disk_blocks.add({"event": "corrupt_read"},
+                            self.disk_tier.corrupt_reads)
+        else:
+            for ev in ("demoted", "promoted", "evicted", "refused",
+                       "corrupt_read"):
+                disk_blocks.add({"event": ev}, 0)
         ttft = MetricFamily(
             "kubeshare_serving_ttft_seconds",
             "Time to first token (submit to first emitted token).",
@@ -1982,7 +2061,7 @@ class ServingEngine:
                 spec_loop_units, exit_reason, depth_summary, host_s,
                 planner, prefix, hit_tokens, evicted, tier_blocks,
                 tier_req, tier_tokens, tier_bytes, tier_stall,
-                tier_corrupt, ttft,
+                tier_corrupt, tier_origin, disk_bytes, disk_blocks, ttft,
                 t_depth, t_blocks, t_tokens, preempt, cls_ttft, tbt,
                 coll_bytes, spec_tokens, spec_accept, tuner]
 
@@ -2081,9 +2160,12 @@ class ServingEngine:
             payload = self._read_block_payload(node)
             key = self.host_tier.put(payload, tenant, node)
             if key is None:
-                device, host_keys = self.prefix_index.detach(node)
+                device, host_keys, disk_keys = \
+                    self.prefix_index.detach(node)
                 for hk in host_keys:
                     self.host_tier.forget(hk)
+                for dk in disk_keys:
+                    self.disk_tier.forget(dk)
                 released.extend(device)
                 self.tier_dropped_blocks += len(device)
                 self.evictions_by_reason["tier_drop"] += len(device)
@@ -2102,19 +2184,53 @@ class ServingEngine:
             stack.extend(
                 child
                 for child in list(node.children.values()) + node.partials
-                if child.host_key is None)
+                if child.block >= 0)
+
+    def _spill_host_entry(self, entry) -> None:
+        """HostTier's budget-eviction hook.  With a disk tier below,
+        the evicted payload CASCADES (HOST→DISK): the bytes move into
+        the mmap arena, the trie node transitions to DISK-resident,
+        and the prefix stays matchable — a disk read + staging away
+        from promotion instead of a re-prefill.  Without one (or when
+        the disk refuses), the entry is destroyed the pre-disk way."""
+        if self.disk_tier is not None and entry.node is not None:
+            dkey = self.disk_tier.put(entry.payload, entry.tenant,
+                                      entry.node, origin=entry.origin)
+            if dkey is not None:
+                self.prefix_index.to_disk(entry.node, dkey)
+                self.host_tier.forget(entry.key)
+                return
+        self._drop_host_entry(entry)
 
     def _drop_host_entry(self, entry) -> None:
-        """HostTier's budget-eviction hook: a host entry leaving the
-        store must take its trie node (and the node's all-host subtree)
-        with it — the cascade's forgets free the bytes."""
-        device, host_keys = self.prefix_index.detach(entry.node)
-        if device:  # host-below-device invariant violated
+        """Destroy a host entry: its trie node (and the node's
+        all-non-device subtree) goes with it — the cascade's forgets
+        free the bytes.  The corrupt-payload path calls this directly
+        (never :meth:`_spill_host_entry` — rotted bytes must not be
+        parked on disk)."""
+        device, host_keys, disk_keys = self.prefix_index.detach(entry.node)
+        if device:  # non-device-below-device invariant violated
             raise RuntimeError(
                 f"host entry {entry.key}'s subtree held device blocks "
                 f"{device} — index/tier state diverged")
         for hk in host_keys:
             self.host_tier.forget(hk)
+        for dk in disk_keys:
+            self.disk_tier.forget(dk)
+
+    def _drop_disk_entry(self, entry) -> None:
+        """DiskTier's budget-eviction hook: the end of the cascade —
+        nothing below disk, so the entry's subtree detaches and every
+        tier copy in it is purged."""
+        device, host_keys, disk_keys = self.prefix_index.detach(entry.node)
+        if device:
+            raise RuntimeError(
+                f"disk entry {entry.key}'s subtree held device blocks "
+                f"{device} — index/tier state diverged")
+        for hk in host_keys:
+            self.host_tier.forget(hk)
+        for dk in disk_keys:
+            self.disk_tier.forget(dk)
 
     def _validate_host_hit(self, hit: _PrefixHit):
         """Deserialize (and crc-check) every host payload ``hit`` will
@@ -2144,18 +2260,25 @@ class ServingEngine:
                 self._drop_host_entry(entry)
         return None
 
-    def _match_prefix(self, pending: _Pending) -> Optional[_PrefixHit]:
+    def _match_prefix(self, pending: _Pending,
+                      limit: Optional[int] = None) -> Optional[_PrefixHit]:
         """Admission-time prefix lookup for one queued request (None =
-        cold).  The tier-aware trie walk may cross HOST-resident nodes:
-        device full matches map as shared blocks, host full matches
-        become promotions, and a partial tail match routes to the CoW
-        copy (device) or a private payload upload (host).  The matched-
-        token cap (prompt - 1) keeps at least one real token in the
-        prefill plan — its logits row IS the first output token."""
+        cold).  The tier-aware trie walk may cross HOST- and DISK-
+        resident nodes: device full matches map as shared blocks,
+        host/disk full matches become promotions (disk ones are staged
+        to host first — :meth:`_stage_disk_hit`), and a partial tail
+        match routes to the CoW copy (device) or a private payload
+        upload (host/disk).  The matched-token cap (prompt - 1) keeps
+        at least one real token in the prefill plan — its logits row IS
+        the first output token.  ``limit`` additionally caps the match
+        (the disk-staging retry path truncates before a block the host
+        tier could not stage)."""
         ec = self.engine_config
         prompt = pending.prompt
         matched, chain = self.prefix_index.match_tiered(prompt)
         matched = min(matched, prompt.size - 1)
+        if limit is not None:
+            matched = min(matched, limit)
         if matched <= 0:
             return None
         chain = chain[: self.allocator.blocks_for_tokens(matched)]
@@ -2164,10 +2287,10 @@ class ServingEngine:
         shared: List[int] = []
         promote: List = []
         for node in chain[:n_full]:
-            if node.host_key is None:
-                if promote:  # host-ness is downward-closed on paths
+            if node.block >= 0:
+                if promote:  # non-device-ness is downward-closed
                     raise RuntimeError(
-                        "device-resident node below a host-resident one "
+                        "device-resident node below a tiered one "
                         "in a match chain — index/tier state diverged")
                 shared.append(node.block)
             else:
@@ -2175,7 +2298,7 @@ class ServingEngine:
         cow_src = host_cow = None
         if partial:
             tail = chain[n_full]
-            if tail.host_key is None:
+            if tail.block >= 0:
                 cow_src = tail.block
             else:
                 host_cow = tail
@@ -2306,6 +2429,76 @@ class ServingEngine:
                 return False
         return True
 
+    def _stage_disk_hit(self, pending: _Pending) -> Optional[_PrefixHit]:
+        """Match + DISK→HOST staging: re-home every disk-resident node
+        the hit would consume into the host tier (read, crc-validate,
+        put, pin) so the promotion path below sees only host payloads.
+        Staging fires at trie-match time, BEFORE the reservation: on an
+        unguarded engine the uploads that follow overlap the in-flight
+        pipelined dispatch (the prefetch overlap the disk tier leans
+        on).  A corrupt disk read drops the node's subtree and
+        re-matches — a shorter or cold admission, never wrong tokens;
+        a host tier that cannot take a staged payload truncates the
+        match just before that block."""
+        limit: Optional[int] = None
+        staged_pins: List[int] = []
+        try:
+            hit = self._match_prefix(pending, limit)
+            while hit is not None:
+                nodes = list(hit.promote)
+                if hit.host_cow is not None:
+                    nodes.append(hit.host_cow)
+                disk_nodes = [n for n in nodes if n.disk_key is not None]
+                if not disk_nodes:
+                    return hit
+                t0 = time.monotonic()
+                clean = True
+                for node in disk_nodes:
+                    dkey = node.disk_key
+                    entry = self.disk_tier.probe(dkey)
+                    payload = self.disk_tier.read(dkey)
+                    try:
+                        unpack_block(payload)
+                    except WireCorruption:
+                        # rot on the platter (or the chaos read seam):
+                        # the node's subtree is unusable — drop it and
+                        # re-match what is left
+                        self.disk_tier.corrupt_reads += 1
+                        self.tier_corrupt_blocks += 1
+                        self._drop_disk_entry(entry)
+                        clean = False
+                        break
+                    hkey = self.host_tier.put(payload, entry.tenant,
+                                              node, origin=entry.origin)
+                    if hkey is None:
+                        # host refused (budget/pins): the block stays
+                        # on disk; truncate the match before it
+                        before = (len(self.prefix_index.path_tokens(node))
+                                  - len(node.tokens))
+                        limit = (before if limit is None
+                                 else min(limit, before))
+                        clean = False
+                        break
+                    # pinned through the rest of staging — a later put
+                    # must not cascade this one straight back to disk
+                    self.host_tier.pin(hkey)
+                    staged_pins.append(hkey)
+                    self.prefix_index.stage_to_host(node, hkey)
+                    self.disk_tier.forget(dkey)
+                    self.disk_tier.promoted_blocks += 1
+                self.tier_promotion_stall_s += time.monotonic() - t0
+                if clean:
+                    # every disk node in the hit is host-resident now;
+                    # the hit's node objects reflect it in place
+                    return hit
+                hit = self._match_prefix(pending, limit)
+            return None
+        finally:
+            # _try_admit re-pins what the hit consumes through its own
+            # pinned list (and nothing touches the tier in between)
+            for k in staged_pins:
+                self.host_tier.unpin(k)
+
     def _try_admit(self, pending: _Pending, spec: TenantSpec,
                    slot: _Slot) -> str:
         """Try to admit one queued request into ``slot``; returns
@@ -2313,8 +2506,12 @@ class ServingEngine:
         "pool" (global shortfall).  A failed attempt rolls back every
         retained block."""
         plan, needed = pending.plan, pending.needed
-        hit = (self._match_prefix(pending)
-               if self.prefix_index is not None else None)
+        if self.prefix_index is None:
+            hit = None
+        elif self.disk_tier is not None:
+            hit = self._stage_disk_hit(pending)
+        else:
+            hit = self._match_prefix(pending)
         if hit is not None:
             plan, needed = hit.plan, hit.needed
         evict_first = (set(self.tenants.opportunistic())
@@ -2404,6 +2601,17 @@ class ServingEngine:
             # stall counter records the host-side staging time
             # (deserialize + enqueue; plus device sync when guarded).
             t0 = time.monotonic()
+            # remote-vs-local split: a hit is "remote" when ANY payload
+            # it consumes was adopted over the fabric (probe before the
+            # takes below surrender the entries)
+            origin = "local"
+            for node in hit.promote + ([hit.host_cow]
+                                       if hit.host_cow is not None
+                                       else []):
+                e = self.host_tier.probe(node.host_key)
+                if e is not None and e.origin == "remote":
+                    origin = "remote"
+                    break
             for node, dst in zip(hit.promote, blocks[:n_promote]):
                 entry = self.host_tier.take(node.host_key)
                 _, k_slab, v_slab = slabs[node.host_key]
@@ -2437,6 +2645,7 @@ class ServingEngine:
                 1 if hit.host_cow is not None else 0)
             self.tier_promotion_stall_s += time.monotonic() - t0
             self.tier_hit_requests += 1
+            self.tier_hit_requests_by_origin[origin] += 1
             self.tier_hit_tokens += hit.host_tokens
         for k in pinned:
             self.host_tier.unpin(k)

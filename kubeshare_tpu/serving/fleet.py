@@ -70,7 +70,10 @@ from .chaos import ReplicaKilled
 from .engine import (EngineConfig, Request, RequestResult, ServingEngine,
                      TTFT_BUCKETS, _Pending, _bucket_observe,
                      _histogram_samples, plan_prefill_chunks)
-from .kv_tier import HostTier, LRUTierPolicy, QoSTierPolicy
+from .fabric import (FabricDirectory, FabricEndpoint, FabricTransport,
+                     K_CHAIN, fabric_metric_families, pack_chain_msg,
+                     prefix_fabric_key, unpack_chain_msg)
+from .kv_tier import HostTier, LRUTierPolicy, QoSTierPolicy, adopt_into
 from .metrics_view import HistogramWindow, interval_quantile
 from .qos import TenantRegistry
 from .sharded import carve_replica_groups
@@ -235,15 +238,34 @@ class PrefixAffinityPolicy(RoutingPolicy):
         blocks = {h.name: h.engine.prefix_match_len(request.prompt) // bs
                   for h in candidates}
         best = max(blocks.values())
-        if best <= 0:
-            return least_loaded, "least_loaded"
-        winner = min((h for h in candidates if blocks[h.name] == best),
-                     key=lambda h: (_load_key(probes[h.name]), h.name))
 
         def saturated(h):
             p = probes[h.name]
             return (p["free_slots"] == 0
                     and p["queue_depth"] >= self.spill_queue_depth)
+
+        if best <= 0:
+            # no LOCAL trie holds any of this prompt — before settling
+            # for least-loaded (a cold prefill), consult the fabric
+            # directory: a published prefix key means some replica's
+            # host/disk tier still holds the blocks, and routing there
+            # turns the miss into a tier promotion.  Longest boundary
+            # first; staleness is safe (a withdrawn owner just prefills
+            # cold, exactly what least-loaded would have done).
+            directory = getattr(fleet, "directory", None)
+            if directory is not None and len(directory) > 0:
+                prompt = np.asarray(request.prompt)
+                names = {h.name: h for h in candidates}
+                top = (prompt.size // bs) * bs
+                for n in range(top, 0, -bs):
+                    for owner in directory.lookup(
+                            prefix_fabric_key(prompt[:n])):
+                        h = names.get(owner)
+                        if h is not None and not saturated(h):
+                            return h, "remote_affinity"
+            return least_loaded, "least_loaded"
+        winner = min((h for h in candidates if blocks[h.name] == best),
+                     key=lambda h: (_load_key(probes[h.name]), h.name))
 
         wp = probes[winner.name]
         if fleet.tenants.get(request.tenant).is_guarantee \
@@ -403,6 +425,8 @@ class ReplicaFleet:
         placement=None,
         shared_tier_bytes: Optional[int] = None,
         tier_policy: str = "lru",
+        fabric: Optional[FabricTransport] = None,
+        fabric_ttl_ticks: int = 16,
         ledger_hook=None,
         replica_factory: Optional[Callable] = None,
         clock: Callable[[], float] = time.monotonic,
@@ -496,6 +520,41 @@ class ReplicaFleet:
             if fault_clock is not None:
                 self.shared_tier.fault_clock = fault_clock
 
+        # the cluster KV fabric (serving/fabric.py): when a transport
+        # is handed in, mirror/drain/salvage chain traffic rides it as
+        # K_CHAIN messages under the at-least-once delivery contract
+        # (per-message crc, TTL, bounded-backoff redelivery) instead of
+        # direct shared-tier inserts, and a directory of published
+        # prefix keys gives the router a remote-affinity path when
+        # every local trie misses
+        self.fabric = fabric
+        self.directory: Optional[FabricDirectory] = None
+        self._fleet_ep: Optional[FabricEndpoint] = None
+        self._endpoints: Dict[str, FabricEndpoint] = {}
+        self._fabric_ttl = fabric_ttl_ticks
+        # sender-side bookkeeping for salvage/handoff accounting:
+        # (sender name, msg_id) -> prompt-token weight of the chain,
+        # and the set of messages some receiver actually adopted
+        self._chain_weight: Dict[Tuple[str, int], int] = {}
+        self._adopted_msgs: set = set()
+        self.fabric_adopted_tokens = 0
+        self.fabric_expired_chains = 0
+        if fabric is not None:
+            if self.shared_tier is None:
+                raise ValueError(
+                    "fabric requires shared_tier_bytes — the chain "
+                    "messages it carries adopt into the fleet's shared "
+                    "host tier")
+            if fabric_ttl_ticks < 1:
+                raise ValueError(
+                    f"fabric_ttl_ticks must be >= 1, got "
+                    f"{fabric_ttl_ticks}")
+            if fault_clock is not None:
+                fabric.fault_clock = fault_clock
+            self.directory = FabricDirectory()
+            self._fleet_ep = FabricEndpoint("fleet", fabric,
+                                            ttl_ticks=fabric_ttl_ticks)
+
         # dp carving: a dp>1 mesh_spec names this fleet's device budget
         self._groups: Optional[List[list]] = None
         self._free_groups: List[int] = []
@@ -521,7 +580,8 @@ class ReplicaFleet:
         self._results: Dict[str, RequestResult] = {}
         self._steps = 0
         self.routing_decisions: Dict[str, int] = {
-            "affinity": 0, "least_loaded": 0, "spill": 0}
+            "affinity": 0, "least_loaded": 0, "spill": 0,
+            "remote_affinity": 0}
         self.scale_events: Dict[str, int] = {"up": 0, "down": 0}
         self._drain_counts = [0] * (len(DRAIN_BUCKETS) + 1)
         self._drain_sum = 0.0
@@ -591,8 +651,13 @@ class ReplicaFleet:
         if self.fault_clock is not None:
             for pool_eng in _pool_engines(eng):
                 pool_eng.fault_clock = self.fault_clock
+                if getattr(pool_eng, "disk_tier", None) is not None:
+                    pool_eng.disk_tier.fault_clock = self.fault_clock
         if uses_tier:
             eng.on_tier_demote = self._mirror_from(handle)
+            if self.fabric is not None:
+                self._endpoints[name] = FabricEndpoint(
+                    name, self.fabric, ttl_ticks=self._fabric_ttl)
         if self.placement is not None:
             handle.placement = self.placement.place(name)
         self._replicas.append(handle)
@@ -683,6 +748,9 @@ class ReplicaFleet:
             self._drain_sum += dur
             self._handoff_trie(handle)
             handle.state = "retired"
+            if self.directory is not None:
+                self.directory.withdraw_owner(handle.name)
+            self._endpoints.pop(handle.name, None)
             if self.placement is not None:
                 self.placement.release(handle.name)
             if handle.group_idx is not None:
@@ -722,6 +790,13 @@ class ReplicaFleet:
         sit unbound)."""
         handle.state = "failed"
         handle.fail_cause = cause
+        if self.directory is not None:
+            # the dead replica's publications go first: a router must
+            # not send remote-affinity traffic at a corpse (stale
+            # entries would still be SAFE — a cold prefill — but there
+            # is no reason to keep them)
+            self.directory.withdraw_owner(handle.name)
+        self._endpoints.pop(handle.name, None)
         for eng in _pool_engines(handle.engine):
             if hasattr(eng, "_consume_inflight"):
                 eng._consume_inflight()
@@ -779,19 +854,40 @@ class ReplicaFleet:
         peers = [p for p in self._replicas
                  if p is not handle and p.state == "active"
                  and p.uses_fleet_tier]
+        if self.fabric is not None:
+            # salvage over the fabric: each entry becomes one K_CHAIN
+            # message per surviving peer, sent from the fleet's own
+            # endpoint (the dead replica cannot speak), then the bus is
+            # pumped to quiescence so the salvage count below reflects
+            # what actually landed — chaos drops are redelivered inside
+            # the pump, expiries surface as lost chains
+            offers: List[Tuple[List[Tuple[str, int]], int]] = []
+            for tokens, payload, tenant, ntok in entries:
+                self.salvage_candidate_tokens += ntok
+                body = pack_chain_msg(
+                    tenant if isinstance(tenant, str) else "",
+                    [(np.asarray(tokens, np.int32), payload)])
+                sent = []
+                for peer in peers:
+                    mid = self._fleet_ep.send(peer.name, K_CHAIN, body)
+                    self._chain_weight[("fleet", mid)] = len(tokens)
+                    sent.append(("fleet", mid))
+                offers.append((sent, ntok))
+            self._pump_fabric_to_quiescence()
+            salvaged = sum(
+                ntok for sent, ntok in offers
+                if any(ref in self._adopted_msgs for ref in sent))
+            self._adopted_msgs.clear()
+            return salvaged
         salvaged = 0
         for tokens, payload, tenant, ntok in entries:
             self.salvage_candidate_tokens += ntok
             adopted_any = False
             for peer in peers:
-                key = self.shared_tier.put(payload, tenant, None)
-                if key is None:
-                    continue
-                adopted = peer.engine.prefix_index.adopt_host(tokens, key)
-                if adopted is None:
-                    self.shared_tier.forget(key)
-                else:
-                    self.shared_tier.bind_node(key, adopted)
+                key = adopt_into(self.shared_tier,
+                                 peer.engine.prefix_index,
+                                 tokens, payload, tenant)
+                if key is not None:
                     adopted_any = True
             if adopted_any:
                 salvaged += ntok
@@ -830,6 +926,12 @@ class ReplicaFleet:
                     pending = lane.items.popleft()[1]
                     orphans.append((pending, eng._results[pending.rid]))
         tickets = list(getattr(handle.engine, "_tickets", ()))
+        # a disagg-pair replica running its handoffs over the fabric
+        # keeps undelivered tickets in the endpoint's in-flight map and
+        # the decode-side arrival queue — both are orphans too
+        tickets += list(getattr(handle.engine, "_fabric_inflight",
+                                {}).values())
+        tickets += list(getattr(handle.engine, "_fabric_arrivals", ()))
         if tickets:
             from .disagg import _ticket_resume_pending
             for ticket in tickets:
@@ -898,18 +1000,35 @@ class ReplicaFleet:
         def on_demote(node, payload: bytes, tenant) -> None:
             src = handle.engine.prefix_index
             tokens = src.path_tokens(node)
+            if self.directory is not None:
+                # the demoting replica now provably holds these bytes
+                # host-side: publish the prefix key so the router's
+                # remote-affinity path can find it after every local
+                # trie misses
+                self.directory.publish(prefix_fabric_key(tokens),
+                                       handle.name,
+                                       token_len=len(tokens))
+            if self.fabric is not None:
+                ep = self._endpoints.get(handle.name)
+                if ep is not None:
+                    body = pack_chain_msg(
+                        tenant if isinstance(tenant, str) else "",
+                        [(np.asarray(tokens, np.int32), payload)])
+                    for peer in self._replicas:
+                        if peer is handle or peer.state != "active" \
+                                or not peer.uses_fleet_tier:
+                            continue
+                        ep.send(peer.name, K_CHAIN, body)
+                return
             for peer in self._replicas:
                 if peer is handle or peer.state != "active" \
                         or not peer.uses_fleet_tier:
                     continue
-                key = self.shared_tier.put(payload, tenant, None)
+                key = adopt_into(self.shared_tier,
+                                 peer.engine.prefix_index,
+                                 tokens, payload, tenant)
                 if key is None:
-                    return
-                adopted = peer.engine.prefix_index.adopt_host(tokens, key)
-                if adopted is None:
-                    self.shared_tier.forget(key)
-                else:
-                    self.shared_tier.bind_node(key, adopted)
+                    return  # tier refused: no budget for more mirrors
         return on_demote
 
     def _handoff_trie(self, handle: ReplicaHandle) -> None:
@@ -949,16 +1068,90 @@ class ReplicaFleet:
         peers = [p for p in self._replicas
                  if p is not handle and p.state == "active"
                  and p.uses_fleet_tier]
+        if self.fabric is not None:
+            # drain inheritance over the fabric: same bus, same
+            # delivery contract as salvage — pumped to quiescence so
+            # the retiree's cache has landed before retirement returns
+            for tokens, payload, tenant in entries:
+                body = pack_chain_msg(
+                    tenant if isinstance(tenant, str) else "",
+                    [(np.asarray(tokens, np.int32), payload)])
+                for peer in peers:
+                    mid = self._fleet_ep.send(peer.name, K_CHAIN, body)
+                    self._chain_weight[("fleet", mid)] = len(tokens)
+            self._pump_fabric_to_quiescence()
+            self._adopted_msgs.clear()
+            return
         for tokens, payload, tenant in entries:
             for peer in peers:
-                key = self.shared_tier.put(payload, tenant, None)
-                if key is None:
+                adopt_into(self.shared_tier, peer.engine.prefix_index,
+                           tokens, payload, tenant)
+
+    # ------------------------------------------------------------------
+    # the fabric pump
+    # ------------------------------------------------------------------
+    def _pump_fabric(self) -> None:
+        """One delivery round for every live endpoint: drain arrivals
+        (adopting K_CHAIN bodies into the receiving replica's trie with
+        ``origin="remote"`` — the tier-hit origin split downstream),
+        then advance every endpoint's virtual clock (redelivery +
+        expiry).  Called once per fleet step; salvage and drain
+        inheritance loop it to quiescence."""
+        if self.fabric is None:
+            return
+        eps = list(self._endpoints.items())
+        if self._fleet_ep is not None:
+            eps.append(("fleet", self._fleet_ep))
+        live = {h.name: h for h in self._replicas
+                if h.state == "active" and h.uses_fleet_tier}
+        for name, ep in eps:
+            for src, kind, mid, body in ep.poll():
+                if kind != K_CHAIN:
                     continue
-                adopted = peer.engine.prefix_index.adopt_host(tokens, key)
-                if adopted is None:
-                    self.shared_tier.forget(key)
-                else:
-                    self.shared_tier.bind_node(key, adopted)
+                handle = live.get(name)
+                if handle is None:
+                    continue  # delivered to a corpse: acked, discarded
+                try:
+                    tenant, items = unpack_chain_msg(body)
+                except ValueError:
+                    continue  # malformed body past the crc: sender bug
+                adopted_any = False
+                for tokens, payload in items:
+                    key = adopt_into(self.shared_tier,
+                                     handle.engine.prefix_index,
+                                     tokens, payload, tenant or None,
+                                     origin="remote")
+                    if key is not None:
+                        adopted_any = True
+                        if self.directory is not None:
+                            self.directory.publish(
+                                prefix_fabric_key(tokens), name,
+                                token_len=len(tokens))
+                if adopted_any:
+                    self._adopted_msgs.add((src, mid))
+                    self.fabric_adopted_tokens += self._chain_weight.get(
+                        (src, mid), 0)
+        for name, ep in eps:
+            ep.tick()
+            for dest, kind, mid, body in ep.take_expired():
+                self.fabric_expired_chains += 1
+                self._chain_weight.pop((name, mid), None)
+
+    def _pump_fabric_to_quiescence(self) -> None:
+        """Pump until no endpoint holds an unacked message — every
+        frame either delivered (ack processed) or TTL-expired.  Bounded
+        by construction: each pump ticks every endpoint once, and an
+        endpoint's outbox empties within its TTL."""
+        if self.fabric is None:
+            return
+        for _ in range(self._fabric_ttl * 4 + 8):
+            eps = list(self._endpoints.values())
+            if self._fleet_ep is not None:
+                eps.append(self._fleet_ep)
+            if not any(ep.inflight for ep in eps):
+                break
+            self._pump_fabric()
+        self._pump_fabric()  # trailing acks
 
     def _route_drop(self, entry) -> None:
         """Shared tier's budget-eviction hook: route the dying entry to
@@ -1048,6 +1241,7 @@ class ReplicaFleet:
                 self._recover_replica(handle, cause)
                 worked = True
         self._finish_drains()
+        self._pump_fabric()
         self._steps += 1
         if self._tuner is not None:
             self._tuner.tick()
@@ -1257,9 +1451,27 @@ class ReplicaFleet:
                     self._tuner.decisions.items()):
                 fam.add({"knob": knob, "direction": direction,
                          "scope": "fleet"}, n)
-        return (list(merged.values())
-                + [replicas, routing, scale, drain, failures, salvaged,
-                   orphans, recovery])
+        out = (list(merged.values())
+               + [replicas, routing, scale, drain, failures, salvaged,
+                  orphans, recovery])
+        if self.fabric is not None:
+            eps = list(self._endpoints.values())
+            if self._fleet_ep is not None:
+                eps.append(self._fleet_ep)
+            out.extend(fabric_metric_families(eps))
+            adopted = MetricFamily(
+                "kubeshare_serving_fabric_chain_tokens_adopted_total",
+                "Prompt tokens whose K/V landed in a receiving "
+                "replica's trie via a fabric chain message")
+            adopted.add({}, self.fabric_adopted_tokens)
+            expired = MetricFamily(
+                "kubeshare_serving_fabric_chains_expired_total",
+                "Chain messages the fabric gave up on (TTL exhausted "
+                "before any ack) — lost mirrors/salvage, never "
+                "corruption")
+            expired.add({}, self.fabric_expired_chains)
+            out.extend([adopted, expired])
+        return out
 
     @staticmethod
     def _merge_samples(dst: MetricFamily, src: MetricFamily) -> None:

@@ -48,7 +48,10 @@ tiering off, test-locked like every other engine property.
 
 from __future__ import annotations
 
+import mmap
+import os
 import struct
+import tempfile
 import zlib
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Set, Tuple
@@ -246,17 +249,21 @@ def unpack_chain(buf: bytes) -> List[bytes]:
 class HostEntry:
     """One demoted block living host-side: the serialized payload, the
     tenant its device HBM was charged to (the policy's protection key),
-    and the trie node still pointing at it."""
+    the trie node still pointing at it, and the origin the payload
+    arrived from (``"local"`` for this engine's own demotions and
+    drain/salvage inheritance, ``"remote"`` for fabric promotions — the
+    label the remote-vs-local tier-hit split reads back)."""
 
-    __slots__ = ("key", "payload", "tenant", "node", "nbytes")
+    __slots__ = ("key", "payload", "tenant", "node", "nbytes", "origin")
 
     def __init__(self, key: int, payload: bytes, tenant: Optional[str],
-                 node) -> None:
+                 node, origin: str = "local") -> None:
         self.key = key
         self.payload = payload
         self.tenant = tenant
         self.node = node
         self.nbytes = len(payload)
+        self.origin = origin
 
 
 class TierPolicy:
@@ -401,8 +408,8 @@ class HostTier:
     def unpin(self, key: int) -> None:
         self._pinned.discard(key)
 
-    def put(self, payload: bytes, tenant: Optional[str], node
-            ) -> Optional[int]:
+    def put(self, payload: bytes, tenant: Optional[str], node,
+            origin: str = "local") -> Optional[int]:
         """Store one serialized block; returns its handle, or None when
         the policy refuses / room cannot be made (caller drops the
         block — the pre-tier destroy path)."""
@@ -435,7 +442,7 @@ class HostTier:
             self.evicted_blocks += evicted
         key = self._next_key
         self._next_key += 1
-        self._entries[key] = HostEntry(key, payload, tenant, node)
+        self._entries[key] = HostEntry(key, payload, tenant, node, origin)
         self.used_bytes += need
         self.peak_bytes = max(self.peak_bytes, self.used_bytes)
         self.stored_blocks += 1
@@ -490,3 +497,265 @@ class HostTier:
         self.used_bytes -= entry.nbytes
         self._pinned.discard(key)
         return True
+
+
+class DiskEntry:
+    """One block parked on disk: where its payload lives in the arena
+    file (offset/nbytes), plus the same tenant/node/origin bookkeeping
+    a :class:`HostEntry` carries.  The payload itself is NOT held in
+    RAM — that is the tier's whole point."""
+
+    __slots__ = ("key", "offset", "nbytes", "tenant", "node", "origin")
+
+    def __init__(self, key: int, offset: int, nbytes: int,
+                 tenant: Optional[str], node, origin: str) -> None:
+        self.key = key
+        self.offset = offset
+        self.nbytes = nbytes
+        self.tenant = tenant
+        self.node = node
+        self.origin = origin
+
+
+class DiskTier:
+    """The mmap-backed, byte-budgeted block store BELOW host RAM.
+
+    Demotion cascades HOST→DISK under host-budget pressure; promotion
+    stages DISK→HOST and rides the existing ``paged_upload_block``
+    admission path from there.  Storing serialized wire-v2 blocks is
+    what makes a disk tier safe at all: every payload carries its own
+    crc32, so rot on the platter (or a chaos-injected flip — the
+    ``fault_clock.on_disk_read`` seam) surfaces as a LOUD
+    :class:`WireCorruption` at validation, a tier miss re-prefilled
+    cold, never wrong tokens.
+
+    Layout: one arena file (a caller-named path, or an unlinked
+    tempfile) grown by doubling and re-mmapped; payloads are placed
+    first-fit from a free-hole list (adjacent holes coalesce on free)
+    or appended at the high-water tail.  The byte budget counts PAYLOAD
+    bytes, not file capacity — fragmentation can make the file larger
+    than the budget, never the live bytes.  Engine-loop confined like
+    :class:`HostTier`; plain LRU eviction (skipping pins) with the same
+    ``on_drop`` detach-cascade contract and no-progress guard."""
+
+    def __init__(self, budget_bytes: int, path: Optional[str] = None,
+                 on_drop: Optional[Callable[[DiskEntry], None]] = None
+                 ) -> None:
+        if budget_bytes < 1:
+            raise ValueError(
+                f"budget_bytes must be >= 1, got {budget_bytes}")
+        self.budget_bytes = budget_bytes
+        self.on_drop = on_drop
+        self.path = path
+        # chaos seam (serving/chaos.py): consulted on every read — may
+        # hand back the payload with a seeded bit flipped (platter
+        # rot); the v2 crc catches it at validation.  None outside
+        # chaos runs.
+        self.fault_clock = None
+        if path is None:
+            fd, tmp = tempfile.mkstemp(prefix="kvdisk-", suffix=".arena")
+            os.unlink(tmp)  # anonymous: the fd is the only handle
+            self._fd = fd
+        else:
+            self._fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
+            os.ftruncate(self._fd, 0)
+        self._capacity = max(mmap.PAGESIZE, 1 << 16)
+        os.ftruncate(self._fd, self._capacity)
+        self._mm = mmap.mmap(self._fd, self._capacity)
+        self._entries: "OrderedDict[int, DiskEntry]" = OrderedDict()
+        self._pinned: Set[int] = set()
+        self._holes: List[Tuple[int, int]] = []  # (offset, size), sorted
+        self._tail = 0
+        self._next_key = 0
+        self.used_bytes = 0
+        self.peak_bytes = 0
+        # lifetime counters (the disk-gauge metric families' raw
+        # material); corrupt_reads is bumped by the CONSUMER when a
+        # disk payload fails wire validation — the tier hands back
+        # bytes, the engine owns the crc verdict.
+        self.stored_blocks = 0
+        self.promoted_blocks = 0
+        self.evicted_blocks = 0
+        self.refused_blocks = 0
+        self.corrupt_reads = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def close(self) -> None:
+        if self._mm is not None:
+            self._mm.close()
+            self._mm = None
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
+
+    def __del__(self) -> None:  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def iter_lru(self):
+        """Entries coldest-first (snapshot — eviction mutates)."""
+        return list(self._entries.items())
+
+    def is_pinned(self, key: int) -> bool:
+        return key in self._pinned
+
+    def pin(self, key: int) -> None:
+        self._pinned.add(key)
+
+    def unpin(self, key: int) -> None:
+        self._pinned.discard(key)
+
+    def _grow(self, need: int) -> None:
+        cap = self._capacity
+        while cap < self._tail + need:
+            cap *= 2
+        os.ftruncate(self._fd, cap)
+        self._mm.close()
+        self._mm = mmap.mmap(self._fd, cap)
+        self._capacity = cap
+
+    def _place(self, nbytes: int) -> int:
+        for i, (off, size) in enumerate(self._holes):
+            if size >= nbytes:  # first fit; remainder stays a hole
+                if size > nbytes:
+                    self._holes[i] = (off + nbytes, size - nbytes)
+                else:
+                    del self._holes[i]
+                return off
+        if self._tail + nbytes > self._capacity:
+            self._grow(nbytes)
+        off = self._tail
+        self._tail += nbytes
+        return off
+
+    def _free(self, offset: int, nbytes: int) -> None:
+        # insert sorted, coalesce with both neighbors
+        holes = self._holes
+        lo, hi = 0, len(holes)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if holes[mid][0] < offset:
+                lo = mid + 1
+            else:
+                hi = mid
+        holes.insert(lo, (offset, nbytes))
+        if lo + 1 < len(holes) and \
+                holes[lo][0] + holes[lo][1] == holes[lo + 1][0]:
+            holes[lo] = (holes[lo][0], holes[lo][1] + holes[lo + 1][1])
+            del holes[lo + 1]
+        if lo > 0 and holes[lo - 1][0] + holes[lo - 1][1] == holes[lo][0]:
+            holes[lo - 1] = (holes[lo - 1][0],
+                             holes[lo - 1][1] + holes[lo][1])
+            del holes[lo]
+        # holes ending at the tail shrink the high-water mark back
+        if holes and holes[-1][0] + holes[-1][1] == self._tail:
+            self._tail = holes[-1][0]
+            del holes[-1]
+
+    def put(self, payload: bytes, tenant: Optional[str], node,
+            origin: str = "local") -> Optional[int]:
+        """Park one serialized block on disk; returns its handle, or
+        None when room cannot be made (the block is destroyed — the
+        pre-disk-tier drop path)."""
+        need = len(payload)
+        if need > self.budget_bytes:
+            self.refused_blocks += 1
+            return None
+        while self.used_bytes + need > self.budget_bytes:
+            before = len(self._entries)
+            for key, entry in self.iter_lru():
+                if key in self._pinned:
+                    continue
+                if self.on_drop is not None:
+                    self.on_drop(entry)  # detach cascade forgets it
+                else:
+                    self.forget(key)
+                break
+            evicted = before - len(self._entries)
+            if evicted <= 0:
+                self.refused_blocks += 1
+                return None  # no progress — everything left is pinned
+            self.evicted_blocks += evicted
+        offset = self._place(need)
+        self._mm[offset: offset + need] = payload
+        key = self._next_key
+        self._next_key += 1
+        self._entries[key] = DiskEntry(key, offset, need, tenant, node,
+                                       origin)
+        self.used_bytes += need
+        self.peak_bytes = max(self.peak_bytes, self.used_bytes)
+        self.stored_blocks += 1
+        return key
+
+    def bind_node(self, key: int, node) -> None:
+        self._entries[key].node = node
+
+    def probe(self, key: int) -> Optional[DiskEntry]:
+        """Entry metadata without payload I/O or LRU side effects."""
+        return self._entries.get(key)
+
+    def _payload(self, entry: DiskEntry) -> bytes:
+        payload = bytes(self._mm[entry.offset: entry.offset + entry.nbytes])
+        if self.fault_clock is not None:
+            payload = self.fault_clock.on_disk_read(payload)
+        return payload
+
+    def read(self, key: int) -> bytes:
+        """Payload bytes WITHOUT removing the entry — touches LRU
+        recency; the chaos read seam applies (validate the crc before
+        trusting a byte)."""
+        entry = self._entries[key]
+        self._entries.move_to_end(key)
+        return self._payload(entry)
+
+    def take(self, key: int) -> bytes:
+        """Remove the entry and return its payload — DISK→HOST staging
+        moved the bytes up a tier; the disk copy is surplus."""
+        entry = self._entries.pop(key)
+        payload = self._payload(entry)
+        self.used_bytes -= entry.nbytes
+        self._pinned.discard(key)
+        self._free(entry.offset, entry.nbytes)
+        self.promoted_blocks += 1
+        return payload
+
+    def forget(self, key: int) -> bool:
+        """Drop an entry without reading it (its trie node was
+        detached elsewhere).  Idempotent."""
+        entry = self._entries.pop(key, None)
+        if entry is None:
+            return False
+        self.used_bytes -= entry.nbytes
+        self._pinned.discard(key)
+        self._free(entry.offset, entry.nbytes)
+        return True
+
+
+def adopt_into(tier: HostTier, index, tokens, payload: bytes,
+               tenant: Optional[str], origin: str = "local"
+               ) -> Optional[int]:
+    """THE host-tier adoption entry point — every path that moves a
+    foreign serialized block under a live trie (a retiree's drain
+    inheritance, a crashed replica's salvage, the disagg cross-pool
+    mirror, a fabric remote promotion) goes through here, so the
+    put→adopt→bind/forget bookkeeping cannot diverge between them.
+
+    Stores ``payload`` in ``tier``, grafts a host-resident node for
+    ``tokens`` (the CUMULATIVE path from the root) into ``index`` via
+    :meth:`PrefixIndex.adopt_host`, and binds entry↔node.  Returns the
+    tier key on success; None (with the tier entry rolled back) when
+    the tier refuses the bytes or the index declines the graft — the
+    caller loses nothing but the opportunity."""
+    key = tier.put(payload, tenant, None, origin=origin)
+    if key is None:
+        return None
+    node = index.adopt_host(tokens, key)
+    if node is None:
+        tier.forget(key)
+        return None
+    tier.bind_node(key, node)
+    return key

@@ -39,16 +39,21 @@ descendants are idle too: every matcher retains the full chain, so a
 child can never outlive its parent's last reference).
 
 **Tiering (kv_tier.py):** a node is DEVICE-resident (``block`` is a
-pool id, ``host_key`` is None) or HOST-resident (``block`` is -1,
-``host_key`` names its serialized payload in the engine's
-:class:`~kubeshare_tpu.serving.kv_tier.HostTier`).  Demotion keeps the
-node IN the trie — that is the whole point: a later prompt's
-:meth:`match_tiered` walk still finds it and the engine promotes the
-payload back into a fresh device block.  Host-ness is downward-closed
-on every root-to-leaf path (demotion spills whole subtrees, promotion
-re-devices root-contiguous match prefixes), so a device node never
-hangs below a host node — :meth:`detach` of a host node releases no
-device blocks, ever.  :meth:`match` keeps its pre-tier contract
+pool id, ``host_key``/``disk_key`` both None), HOST-resident
+(``block`` is -1, ``host_key`` names its serialized payload in the
+engine's :class:`~kubeshare_tpu.serving.kv_tier.HostTier`) or
+DISK-resident (``block`` is -1, ``disk_key`` names it in the
+:class:`~kubeshare_tpu.serving.kv_tier.DiskTier` below host RAM).
+Demotion keeps the node IN the trie — that is the whole point: a later
+prompt's :meth:`match_tiered` walk still finds it and the engine
+promotes the payload back up (DISK→HOST staging, then the HOST→device
+upload).  Non-device-ness is downward-closed on every root-to-leaf
+path (demotion spills whole subtrees parent-first, promotion
+re-devices root-contiguous match prefixes; host and disk may
+interleave below the frontier as per-entry LRU pressure moves
+payloads between them), so a device node never hangs below a host or
+disk node — :meth:`detach` of a non-device node releases no device
+blocks, ever.  :meth:`match` keeps its pre-tier contract
 (device-resident chain only), so every tiering-off caller is untouched.
 """
 
@@ -67,7 +72,7 @@ def _lcp(a: Sequence[int], b: Sequence[int]) -> int:
 
 class _Node:
     __slots__ = ("tokens", "block", "parent", "children", "partials",
-                 "host_key")
+                 "host_key", "disk_key")
 
     def __init__(self, tokens: Tuple[int, ...], block: int,
                  parent: Optional["_Node"]) -> None:
@@ -80,10 +85,17 @@ class _Node:
         self.partials: List["_Node"] = []
         # HostTier handle when demoted (None = device-resident)
         self.host_key: Optional[int] = None
+        # DiskTier handle when cascaded below host RAM (exclusive with
+        # host_key — a node lives in exactly one tier at a time)
+        self.disk_key: Optional[int] = None
 
     @property
     def location(self) -> str:
-        return "device" if self.host_key is None else "host"
+        if self.host_key is not None:
+            return "host"
+        if self.disk_key is not None:
+            return "disk"
+        return "device"
 
 
 class PrefixIndex:
@@ -104,6 +116,8 @@ class PrefixIndex:
         # side effect of evicting a device ancestor or displacing an
         # upgraded leaf — the tier entry must not outlive its node.
         self.host_drop: Optional[Callable[[int], bool]] = None
+        # the DISK twin (DiskTier.forget), same contract
+        self.disk_drop: Optional[Callable[[int], bool]] = None
 
     @property
     def cached_blocks(self) -> int:
@@ -130,7 +144,7 @@ class PrefixIndex:
         pos = 0
         while len(toks) - pos >= bs:
             child = node.children.get(tuple(toks[pos: pos + bs]))
-            if child is None or child.host_key is not None:
+            if child is None or child.block < 0:
                 break
             blocks.append(child.block)
             pos += bs
@@ -144,7 +158,7 @@ class PrefixIndex:
         best, best_block = 0, -1
         if rem:
             for child in list(node.children.values()) + node.partials:
-                if child.host_key is not None or child.tokens[0] != rem[0]:
+                if child.block < 0 or child.tokens[0] != rem[0]:
                     continue
                 l = _lcp(child.tokens, rem)
                 if l > best:
@@ -287,15 +301,13 @@ class PrefixIndex:
             if len(seg) == bs:
                 child = node.children.get(seg)
                 if child is not None:
-                    if child.host_key is not None:
-                        # HOST-resident under identical tokens and the
-                        # retiree holds the SAME rows on device: rebind
-                        # the node to the device block (a free
-                        # promotion — no upload) and drop the host copy
-                        hk = child.host_key
+                    if child.block < 0:
+                        # HOST/DISK-resident under identical tokens and
+                        # the retiree holds the SAME rows on device:
+                        # rebind the node to the device block (a free
+                        # promotion — no upload) and drop the tier copy
+                        self._drop_tier_copy(child)
                         self.promote(child, block)
-                        if self.host_drop is not None:
-                            self.host_drop(hk)
                         newly_cached.append(block)
                     # else: already device-cached; ours is surplus
                     node = child
@@ -310,13 +322,10 @@ class PrefixIndex:
                         break
                 if upgraded is not None:
                     node.partials.remove(upgraded)
-                    if upgraded.host_key is not None:
-                        # the host partial's payload is superseded by
+                    if upgraded.block < 0:
+                        # the tiered partial's payload is superseded by
                         # the full device block upgrading it
-                        hk = upgraded.host_key
-                        upgraded.host_key = None
-                        if self.host_drop is not None:
-                            self.host_drop(hk)
+                        self._drop_tier_copy(upgraded)
                     elif upgraded.block != block:
                         displaced.append(upgraded.block)
                         self._by_block.pop(upgraded.block, None)
@@ -354,13 +363,10 @@ class PrefixIndex:
                 if covered is not None:
                     break  # existing leaf already holds (at least) ours
                 if extended is not None:
-                    if extended.host_key is not None:
-                        # upgrading a HOST partial leaf: the device
-                        # block supersedes the (shorter) host payload
-                        hk = extended.host_key
-                        extended.host_key = None
-                        if self.host_drop is not None:
-                            self.host_drop(hk)
+                    if extended.block < 0:
+                        # upgrading a HOST/DISK partial leaf: the device
+                        # block supersedes the (shorter) tiered payload
+                        self._drop_tier_copy(extended)
                     elif extended.block != block:
                         displaced.append(extended.block)
                         self._by_block.pop(extended.block, None)
@@ -376,6 +382,18 @@ class PrefixIndex:
         return newly_cached, displaced
 
     # ------------------------------------------------------------------
+    def _drop_tier_copy(self, node: _Node) -> None:
+        """Clear a node's host/disk residency and purge the tier entry
+        through the engine-installed drop hooks — the device block
+        superseding it is bound by the caller."""
+        hk, dk = node.host_key, node.disk_key
+        node.host_key = None
+        node.disk_key = None
+        if hk is not None and self.host_drop is not None:
+            self.host_drop(hk)
+        if dk is not None and self.disk_drop is not None:
+            self.disk_drop(dk)
+
     def node_of(self, block: int) -> Optional[_Node]:
         """The node holding DEVICE block ``block`` (None when the
         block is not cached) — the tiering engine's entry point into
@@ -398,15 +416,36 @@ class PrefixIndex:
         into pool block ``block`` (or a retiree re-materialized the
         same tokens there)."""
         node.host_key = None
+        node.disk_key = None
         node.block = block
         self._by_block[block] = node
 
-    def detach(self, node: _Node) -> Tuple[List[int], List[int]]:
+    def to_disk(self, node: _Node, disk_key: int) -> None:
+        """HOST→DISK cascade: the node's host payload moved down a
+        tier under host-budget pressure — still in the trie, still
+        matchable, now a :class:`~kubeshare_tpu.serving.kv_tier.
+        DiskTier` read away from promotion."""
+        if node.host_key is None:
+            raise ValueError("to_disk requires a HOST-resident node")
+        node.host_key = None
+        node.disk_key = disk_key
+
+    def stage_to_host(self, node: _Node, host_key: int) -> None:
+        """DISK→HOST staging: the payload was read off disk, validated,
+        and re-stored host-side; the node transitions back up one tier
+        (the existing host promotion path takes it from here)."""
+        if node.disk_key is None:
+            raise ValueError("stage_to_host requires a DISK-resident node")
+        node.disk_key = None
+        node.host_key = host_key
+
+    def detach(self, node: _Node) -> Tuple[List[int], List[int], List[int]]:
         """Unlink ``node`` and its whole subtree from the trie;
-        returns (device_blocks, host_keys) released — the caller owns
-        returning the blocks to the allocator and forgetting the host
-        entries.  A host node's subtree is all-host (see module
-        docstring), so detaching one never releases device blocks."""
+        returns (device_blocks, host_keys, disk_keys) released — the
+        caller owns returning the blocks to the allocator and
+        forgetting the tier entries.  A non-device node's subtree is
+        all non-device (see module docstring), so detaching one never
+        releases device blocks."""
         parent = node.parent
         if len(node.tokens) == self.block_size:
             del parent.children[node.tokens]
@@ -414,17 +453,20 @@ class PrefixIndex:
             parent.partials.remove(node)
         device: List[int] = []
         host_keys: List[int] = []
+        disk_keys: List[int] = []
         stack = [node]
         while stack:
             n = stack.pop()
             if n.host_key is not None:
                 host_keys.append(n.host_key)
+            elif n.disk_key is not None:
+                disk_keys.append(n.disk_key)
             else:
                 device.append(n.block)
                 self._by_block.pop(n.block, None)
             stack.extend(n.children.values())
             stack.extend(n.partials)
-        return device, host_keys
+        return device, host_keys, disk_keys
 
     # ------------------------------------------------------------------
     def owns(self, node: _Node) -> bool:
@@ -503,8 +545,11 @@ class PrefixIndex:
         node = self._by_block.get(block)
         if node is None:
             return []
-        device, host_keys = self.detach(node)
+        device, host_keys, disk_keys = self.detach(node)
         if self.host_drop is not None:
             for hk in host_keys:
                 self.host_drop(hk)
+        if self.disk_drop is not None:
+            for dk in disk_keys:
+                self.disk_drop(dk)
         return device

@@ -38,7 +38,7 @@ def _fleet(params, config, *, replicas=2, num_blocks=21, **overrides):
     fleet_kwargs = dict(replicas=replicas)
     for k in ("routing", "tenants", "shared_tier_bytes", "clock",
               "fault_clock", "liveness_grace", "watchdog_budget_s",
-              "watchdog_grace"):
+              "watchdog_grace", "fabric", "fabric_ttl_ticks"):
         if k in overrides:
             fleet_kwargs[k] = overrides.pop(k)
     ec_kwargs.update(overrides)
@@ -779,3 +779,221 @@ class TestTokendRetry:
 
         with pytest.raises(ValueError):
             TokenClient("127.0.0.1", 1, "ns/pod-a", max_retries=-1)
+
+
+class TestFabricChaos:
+    """The fabric's chaos seams: seeded frame drop / duplicate /
+    reorder / corruption across the cluster KV fabric, and rotten disk
+    sectors under the DISK tier — every fault is absorbed by the
+    at-least-once redelivery contract (or the crc) and the streams stay
+    BIT-EXACT with the fault-free arm."""
+
+    def test_fabric_builders_validate_and_chain(self):
+        from kubeshare_tpu.serving.chaos import FaultPlan
+
+        plan = (FaultPlan(seed=9).drop_fabric(0).duplicate_fabric(2)
+                .reorder_fabric(4).corrupt_fabric(6)
+                .corrupt_disk_read(1))
+        assert plan.fabric_drops == {0}
+        assert plan.fabric_duplicates == {2}
+        assert plan.fabric_reorders == {4}
+        assert plan.fabric_corruptions == {6}
+        assert plan.disk_corruptions == {1}
+        for bad in (lambda p: p.drop_fabric(-1),
+                    lambda p: p.duplicate_fabric(-1),
+                    lambda p: p.reorder_fabric(-1),
+                    lambda p: p.corrupt_fabric(-1),
+                    lambda p: p.corrupt_disk_read(-1)):
+            with pytest.raises(ValueError):
+                bad(FaultPlan())
+
+    def test_fabric_transmit_faults_are_seeded_and_deterministic(self):
+        """Replay determinism at the seam: the same plan mutates the
+        same frame the same way; a different seed flips a different
+        bit."""
+        from kubeshare_tpu.serving.chaos import FaultClock, FaultPlan
+
+        frame = bytes(range(64)) * 3
+
+        def run(seed):
+            clock = FaultClock(FaultPlan(seed=seed).corrupt_fabric(0))
+            return clock.on_fabric_transmit(frame)
+
+        a, b, c = run(3), run(3), run(4)
+        assert a == b and a != c
+        assert len(a) == 1 and len(a[0][0]) == len(frame)
+        clock = FaultClock(FaultPlan(seed=3).drop_fabric(0)
+                           .duplicate_fabric(1).reorder_fabric(2))
+        assert clock.on_fabric_transmit(frame) == []
+        assert clock.on_fabric_transmit(frame) == [(frame, False),
+                                                   (frame, False)]
+        assert clock.on_fabric_transmit(frame) == [(frame, True)]
+        assert clock.on_fabric_transmit(frame) == [(frame, False)]
+        kinds = [e[0] for e in clock.events]
+        assert kinds == ["drop_fabric", "duplicate_fabric",
+                         "reorder_fabric"]
+
+    def test_fleet_drain_over_faulty_fabric_bit_exact(self):
+        """Drain inheritance over a fabric losing, duplicating,
+        reordering AND corrupting frames: redelivery recovers every
+        chain, the survivor still inherits the retiree's prefix, the
+        streams equal the fault-free fleet's, and the send-side
+        counters reconcile (delivered + expired == sent, nothing in
+        flight)."""
+        from kubeshare_tpu.serving import Request
+        from kubeshare_tpu.serving.chaos import FaultClock, FaultPlan
+        from kubeshare_tpu.serving.fabric import LoopbackTransport
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+
+        def run(clock):
+            fleet = _fleet(params, config, shared_tier_bytes=1 << 20,
+                           fault_clock=clock,
+                           fabric=LoopbackTransport(),
+                           fabric_ttl_ticks=12)
+            fleet.warmup()
+            rng = np.random.default_rng(11)
+            shared = rng.integers(0, 64, 16)
+
+            def req(rid):
+                return Request(rid, np.concatenate(
+                    [shared, rng.integers(0, 64, 4)]), 4)
+
+            streams = {}
+            fleet.submit(req("seed"))
+            streams.update(
+                {r: o.tokens for r, o in fleet.run().items()})
+            owner = fleet.owner_of("seed")
+            fleet.drain(owner)
+            fleet.run()
+            fleet.submit(req("heir"))
+            streams.update(
+                {r: o.tokens for r, o in fleet.run().items()})
+            return fleet, streams
+
+        plan = FaultPlan(seed=21)
+        # rough the early frames up: ordinals count EVERY transmit
+        # (data, acks, redeliveries), so this hits a mix of both
+        for n in (0, 5):
+            plan.drop_fabric(n)
+        plan.corrupt_fabric(2).duplicate_fabric(3).reorder_fabric(7)
+        clock = FaultClock(plan)
+        chaotic, got = run(clock)
+        _, want = run(None)
+        assert got == want  # bit-exact with the fault-free arm
+        faults = {e[0] for e in clock.events}
+        assert "drop_fabric" in faults and "corrupt_fabric" in faults
+        eps = list(chaotic._endpoints.values()) + [chaotic._fleet_ep]
+        assert all(ep.inflight == 0 for ep in eps)
+        sent = sum(ep.messages.get(("chain", "sent"), 0) for ep in eps)
+        delivered = sum(ep.messages.get(("chain", "delivered"), 0)
+                        for ep in eps)
+        expired = sum(ep.messages.get(("chain", "expired"), 0)
+                      for ep in eps)
+        assert sent > 0 and delivered + expired == sent
+        assert sum(ep.redeliveries for ep in eps) > 0
+        fams = chaotic.collect_metrics()
+        assert _metric(fams,
+                       "kubeshare_serving_fabric_redeliveries_total") > 0
+        # the survivor still inherited the retiree's prefix
+        assert chaotic.fabric_adopted_tokens > 0
+
+    def test_disagg_tickets_over_faulty_fabric_bit_exact(self):
+        """Handoff tickets through a lossy fabric: a dropped ticket
+        frame redelivers under backoff, a dropped ACK dedups on the
+        decode side, and the split-pool streams still equal the
+        monolithic engine's token for token."""
+        from kubeshare_tpu.serving import (DisaggRouter, EngineConfig,
+                                           Request, ServingEngine)
+        from kubeshare_tpu.serving.chaos import FaultClock, FaultPlan
+        from kubeshare_tpu.serving.fabric import LoopbackTransport
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+
+        def reqs():
+            return [Request(
+                f"r{i}", np.arange(3 + i * 2) % 60, 8,
+                temperature=(0.0 if i % 2 else 0.7),
+                rng=(None if i % 2 else jax.random.PRNGKey(100 + i)))
+                for i in range(5)]
+
+        mono = ServingEngine(params, config, EngineConfig(
+            num_slots=3, block_size=4, num_blocks=41,
+            max_request_len=48, prefill_chunk=8, mixed=False))
+        for r in reqs():
+            mono.submit(r)
+        want = {rid: res.tokens for rid, res in mono.run().items()}
+
+        plan = (FaultPlan(seed=31).drop_fabric(0).drop_fabric(3)
+                .duplicate_fabric(5).corrupt_fabric(7))
+        clock = FaultClock(plan)
+        fabric = LoopbackTransport()
+        fabric.fault_clock = clock
+        router = DisaggRouter(
+            params, config,
+            EngineConfig(num_slots=2, block_size=4, num_blocks=17,
+                         max_request_len=48, prefill_chunk=8,
+                         mixed=False),
+            EngineConfig(num_slots=3, block_size=4, num_blocks=25,
+                         max_request_len=48, prefill_chunk=8,
+                         mixed=False),
+            fabric=fabric, fabric_ttl_ticks=12)
+        for r in reqs():
+            router.submit(r)
+        got = {rid: res.tokens for rid, res in router.run().items()}
+        assert got == want
+        assert clock.events  # the plan actually fired
+        assert router._fabric_inflight == {}
+        assert router._fabric_arrivals == []
+        pf, dc = router._fabric_pf, router._fabric_dc
+        assert pf.inflight == 0
+        assert (pf.messages.get(("ticket", "delivered"), 0)
+                + pf.messages.get(("ticket", "expired"), 0)
+                == pf.messages[("ticket", "sent")])
+        assert pf.redeliveries + dc.redeliveries > 0
+
+    def test_disk_rot_is_a_loud_miss_not_wrong_tokens(self):
+        """Rot EVERY disk sector read: each staged promotion detects
+        the flip (block crc), drops the node's subtree, and the request
+        re-prefills cold — the stream equals the dense reference, and
+        the corruption is counted on the metrics plane."""
+        from kubeshare_tpu.models.decoding import greedy_decode
+        from kubeshare_tpu.serving import (EngineConfig, Request,
+                                           ServingEngine,
+                                           wire_block_bytes)
+        from kubeshare_tpu.serving.chaos import FaultClock, FaultPlan
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        full_wire = wire_block_bytes(4, config.n_layers, config.kv_heads,
+                                     4, config.head_dim, 4)
+        engine = ServingEngine(params, config, EngineConfig(
+            num_slots=1, block_size=4, num_blocks=13,
+            max_request_len=32, prefill_chunk=8,
+            host_tier_bytes=3 * full_wire, disk_tier_bytes=1 << 20))
+        plan = FaultPlan(seed=23)
+        for n in range(200):
+            plan.corrupt_disk_read(n)
+        engine.disk_tier.fault_clock = FaultClock(plan)
+        rng = np.random.default_rng(11)
+        shared = rng.integers(0, 64, 13)
+        for rid, prompt in (("r0", shared),
+                            ("f1", rng.integers(0, 64, 29)),
+                            ("f2", rng.integers(0, 64, 29))):
+            engine.submit(Request(rid, prompt, 3))
+            engine.run()
+            engine.pop_finished()
+        assert engine.disk_tier.stored_blocks > 0
+        hit = np.concatenate([shared, rng.integers(0, 64, 4)])
+        engine.submit(Request("hit", hit, 3))
+        out = engine.run()
+        ref = np.asarray(greedy_decode(
+            params, config, jnp.asarray(hit, jnp.int32)[None], 3))[0]
+        assert out["hit"].tokens == list(ref)
+        assert engine.disk_tier.corrupt_reads > 0
+        fams = engine.collect_metrics()
+        assert _metric(fams,
+                       "kubeshare_serving_disk_tier_blocks_total",
+                       event="corrupt_read") > 0

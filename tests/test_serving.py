@@ -3208,3 +3208,703 @@ class TestServingBenchSmoke:
         assert result["disagg"]["ttft_by_pool_s"]["prefill"]["p50"] > 0
         assert result["disagg"]["tokens_per_s"] > 0
         assert result["monolithic"]["tokens_per_s"] > 0
+
+    def test_fabric_smoke_promotes_across_a_process_boundary(self):
+        """The --fabric smoke path: the publisher's demotion cascade
+        parks document blocks on the mmap disk arena, a jax-free child
+        PROCESS serves the exported store over TCP, and the cold
+        fabric-on arm adopts the fetched chains so first touches are
+        remote-origin tier hits.  The tiny model's timing ratios are
+        noisy on CPU (the full bench owns docs/perf.md's numbers);
+        what IS locked: disk blocks were actually demoted, bytes
+        actually crossed the process boundary, the remote-origin
+        tier-hit split is nonzero, the fabric-on hit rate beats
+        fabric-off, every stream is bit-exact across arms
+        (run_fabric_bench's internal hard assert), and nothing
+        recompiles."""
+        import importlib.util
+        import os
+
+        spec = importlib.util.spec_from_file_location(
+            "serving_bench", os.path.join(
+                os.path.dirname(__file__), "..", "benchmarks",
+                "serving_bench.py"))
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        result = bench.run_fabric_bench(bench.fabric_smoke_settings(),
+                                        aba=False)
+        assert result["recompiles_after_warmup"] == 0
+        assert result["streams_bit_exact"] is True
+        assert result["store"]["chains"] > 0
+        assert result["store"]["publisher_disk_demoted"] > 0
+        assert result["fetch"]["fetches"] > 0
+        assert result["fetch"]["bytes_fetched"] > 0
+        assert result["fetch"]["adopted_blocks"] > 0
+        assert result["remote_tier_hits"] > 0
+        assert result["fabric_on"]["tier_hit_origin"]["remote"] > 0
+        assert result["hit_rate"]["fabric_on"] \
+            > result["hit_rate"]["fabric_off"]
+        assert result["fabric_on"]["tokens_per_s"] > 0
+
+
+class TestDiskTier:
+    """The mmap-backed DISK tier below host RAM (serving/kv_tier.py
+    DiskTier + the engine's HOST→DISK demotion cascade and
+    DISK→HOST→device promotion staging): arena round-trips are byte
+    identical, the byte budget refuses and evicts like the host store,
+    disk-tier-on streams are bit-exact with tier-off, and the gauges
+    land on the metrics plane."""
+
+    def _reqs(self, rng, shared):
+        return [
+            dict(rid="r0", prompt=shared, max_new_tokens=3),
+            dict(rid="f1", prompt=rng.integers(0, 64, 29),
+                 max_new_tokens=3),
+            dict(rid="f2", prompt=rng.integers(0, 64, 29),
+                 max_new_tokens=3),
+            dict(rid="hit", prompt=np.concatenate(
+                [shared, rng.integers(0, 64, 4)]), max_new_tokens=3),
+        ]
+
+    def _run_sequentially(self, engine, reqs):
+        from kubeshare_tpu.serving import Request
+
+        out = {}
+        for req in reqs:
+            engine.submit(Request(**req))
+            out.update({rid: r.tokens for rid, r in engine.run().items()
+                        if r.done})
+            engine.pop_finished()
+        return out
+
+    def _disk_engine(self, params, config, **over):
+        from kubeshare_tpu.serving import (EngineConfig, ServingEngine,
+                                           wire_block_bytes)
+
+        full_wire = wire_block_bytes(4, config.n_layers, config.kv_heads,
+                                     4, config.head_dim, 4)
+        kwargs = dict(num_slots=1, block_size=4, num_blocks=13,
+                      max_request_len=32, prefill_chunk=8,
+                      host_tier_bytes=3 * full_wire,
+                      disk_tier_bytes=1 << 20)
+        kwargs.update(over)
+        return ServingEngine(params, config, EngineConfig(**kwargs))
+
+    def test_arena_roundtrip_budget_and_hole_reuse(self):
+        """The store itself: put/read/take are byte identical through
+        the mmap (including across a growth re-map), the PAYLOAD-byte
+        budget evicts LRU (never pins) and refuses oversized blocks,
+        and freed extents coalesce for reuse."""
+        from kubeshare_tpu.serving import DiskTier
+
+        tier = DiskTier(budget_bytes=300)
+        a = tier.put(b"a" * 100, None, None)
+        b = tier.put(b"b" * 100, None, None)
+        c = tier.put(b"c" * 100, None, None)
+        assert tier.read(a) == b"a" * 100
+        assert tier.used_bytes == 300
+        # budget full: the next put evicts the coldest (b — a was
+        # touched by the read above)
+        d = tier.put(b"d" * 100, None, None)
+        assert tier.probe(b) is None and tier.evicted_blocks == 1
+        assert tier.read(d) == b"d" * 100
+        # take() promotes: bytes come back identical, space frees
+        assert tier.take(c) == b"c" * 100
+        assert tier.promoted_blocks == 1 and tier.used_bytes == 200
+        # pinned entries are never victims; an all-pinned store refuses
+        for key in (a, d):
+            tier.pin(key)
+        e = tier.put(b"e" * 100, None, None)
+        assert e is not None  # c's hole funds it without eviction
+        tier.pin(e)
+        assert tier.put(b"f" * 100, None, None) is None
+        assert tier.refused_blocks == 1
+        # over-budget payloads are refused up front
+        assert tier.put(b"x" * 301, None, None) is None
+        # growth re-map preserves existing payloads bit for bit
+        big = DiskTier(budget_bytes=1 << 22)
+        k1 = big.put(b"q" * 37, None, None)
+        k2 = big.put(b"z" * (1 << 20), None, None)  # forces _grow
+        assert big.read(k1) == b"q" * 37
+        assert big.read(k2) == b"z" * (1 << 20)
+        tier.close()
+        big.close()
+
+    def test_named_arena_file_is_a_real_mmap_file(self, tmp_path):
+        """disk_tier_path pins the arena to a caller-named file — the
+        bench's cross-process handle; payloads placed through it read
+        back byte identical from a fresh mmap of the same file."""
+        import mmap as _mmap
+        import os as _os
+
+        from kubeshare_tpu.serving import DiskTier
+
+        path = str(tmp_path / "kv.arena")
+        tier = DiskTier(budget_bytes=1 << 16, path=path)
+        payload = bytes(np.random.default_rng(0).integers(
+            0, 256, 777, dtype=np.uint8))
+        key = tier.put(payload, None, None)
+        entry = tier.probe(key)
+        fd = _os.open(path, _os.O_RDONLY)
+        try:
+            mm = _mmap.mmap(fd, 0, prot=_mmap.PROT_READ)
+            assert bytes(mm[entry.offset: entry.offset
+                            + entry.nbytes]) == payload
+            mm.close()
+        finally:
+            _os.close(fd)
+        tier.close()
+
+    def test_streams_bit_exact_with_disk_tier_across_configs(self):
+        """Disk tier on vs everything off, token for token, through a
+        forced HOST→DISK→HOST→device cascade (the host budget takes 3
+        wire blocks, the flushers demote 8+) — GQA and windowed
+        attention included."""
+        cases = {
+            "plain": dict(),
+            "gqa_rope": dict(n_kv_heads=2, positional="rope"),
+            "windowed": dict(attention_window=6),
+        }
+        rng = np.random.default_rng(11)
+        shared = rng.integers(0, 64, 13)
+        reqs = self._reqs(rng, shared)
+        for name, extra in cases.items():
+            config = _small_config(**extra)
+            params = transformer_init(jax.random.PRNGKey(0), config)
+            disked = self._disk_engine(params, config)
+            plain = self._disk_engine(params, config,
+                                      host_tier_bytes=None,
+                                      disk_tier_bytes=None)
+            got = self._run_sequentially(disked, reqs)
+            want = self._run_sequentially(plain, reqs)
+            assert got == want, name
+            assert disked.disk_tier.stored_blocks > 0, name
+            assert disked.disk_tier.promoted_blocks > 0, name
+            assert disked.tier_hit_requests_by_origin["local"] >= 1
+
+    def test_sampled_streams_bit_exact_with_disk_tier(self):
+        config = _small_config(n_kv_heads=2, positional="rope")
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        rng = np.random.default_rng(13)
+        shared = rng.integers(0, 64, 13)
+        reqs = []
+        for i, req in enumerate(self._reqs(rng, shared)):
+            req.update(temperature=0.8, rng=jax.random.PRNGKey(40 + i))
+            reqs.append(req)
+        disked = self._disk_engine(params, config, top_k=10)
+        plain = self._disk_engine(params, config, top_k=10,
+                                  host_tier_bytes=None,
+                                  disk_tier_bytes=None)
+        got = self._run_sequentially(disked, reqs)
+        want = self._run_sequentially(plain, reqs)
+        assert got == want
+        assert disked.disk_tier.promoted_blocks > 0
+
+    def test_zero_recompiles_with_disk_promotions(self):
+        """The cascade adds no dispatch shapes: promotion from disk
+        rides the SAME warmed upload path a host hit uses."""
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        engine = self._disk_engine(params, config)
+        engine.warmup()
+        baseline = engine.compile_counts()
+        rng = np.random.default_rng(37)
+        shared = rng.integers(0, 64, 13)
+        self._run_sequentially(engine, self._reqs(rng, shared))
+        assert engine.disk_tier.promoted_blocks > 0
+        assert engine.compile_counts() == baseline
+
+    def test_disk_gauges_on_metrics_plane(self):
+        from kubeshare_tpu.serving import flatten_metrics, metric_value
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        engine = self._disk_engine(params, config)
+        rng = np.random.default_rng(11)
+        shared = rng.integers(0, 64, 13)
+        self._run_sequentially(engine, self._reqs(rng, shared))
+        fams = flatten_metrics(engine.collect_metrics())
+        assert metric_value(fams, "kubeshare_serving_disk_tier_blocks_total",
+                            event="demoted") > 0
+        assert metric_value(fams, "kubeshare_serving_disk_tier_blocks_total",
+                            event="promoted") > 0
+        assert metric_value(fams, "kubeshare_serving_disk_tier_bytes",
+                            kind="budget") == 1 << 20
+        assert metric_value(fams, "kubeshare_serving_disk_tier_bytes",
+                            kind="used") >= 0
+        # the remote-vs-local tier-hit split is on the plane too
+        assert metric_value(
+            fams, "kubeshare_serving_tier_hit_origin_requests_total",
+            origin="local") >= 1
+        assert metric_value(
+            fams, "kubeshare_serving_tier_hit_origin_requests_total",
+            origin="remote") == 0
+
+    def test_config_validation_is_loud(self):
+        from kubeshare_tpu.serving import EngineConfig, ServingEngine
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        with pytest.raises(ValueError, match="requires host_tier_bytes"):
+            ServingEngine(params, config, EngineConfig(
+                num_slots=1, block_size=4, num_blocks=13,
+                max_request_len=32, disk_tier_bytes=1 << 20))
+        with pytest.raises(ValueError, match="disk_tier_path"):
+            ServingEngine(params, config, EngineConfig(
+                num_slots=1, block_size=4, num_blocks=13,
+                max_request_len=32, host_tier_bytes=1 << 20,
+                disk_tier_path="/tmp/x.arena"))
+
+
+class TestFabric:
+    """The cluster KV fabric (serving/fabric.py): envelope honesty
+    (crc-first, loud corruption), bit-identical chain round-trips over
+    a REAL socketpair, at-least-once endpoint delivery with ack/dedup/
+    TTL/bounded backoff, the prefix directory's remote-affinity hook in
+    fleet routing, drain inheritance riding the fabric, the disagg
+    ticket bus, and the exportable prefix store."""
+
+    def test_message_envelope_roundtrip_and_corruption(self):
+        from kubeshare_tpu.serving import (WireCorruption, pack_message,
+                                           unpack_message)
+        from kubeshare_tpu.serving.fabric import K_CHAIN
+
+        body = b"\x01payload bytes\xff" * 9
+        frame = pack_message(K_CHAIN, 42, "alpha", "beta", body)
+        kind, mid, src, dest, got = unpack_message(frame)
+        assert (kind, mid, src, dest, got) == (
+            K_CHAIN, 42, "alpha", "beta", body)
+        # any single flipped bit — header, body, crc trailer — is a
+        # typed WireCorruption, checked BEFORE any envelope field
+        for at in (0, 3, 11, len(frame) // 2, len(frame) - 1):
+            bad = bytearray(frame)
+            bad[at] ^= 0x10
+            with pytest.raises(WireCorruption):
+                unpack_message(bytes(bad))
+        with pytest.raises(WireCorruption, match="truncated"):
+            unpack_message(frame[:8])
+        # intact-but-foreign frames are plain ValueErrors (re-sealed so
+        # the crc passes and the magic/version checks are reachable)
+        import struct as _struct
+        import zlib as _zlib
+
+        def reseal(b: bytes) -> bytes:
+            return b[:-4] + _struct.pack(
+                "<I", _zlib.crc32(b[:-4]) & 0xFFFFFFFF)
+
+        with pytest.raises(ValueError, match="magic"):
+            unpack_message(reseal(b"XXXX" + frame[4:]))
+        with pytest.raises(ValueError, match="version"):
+            unpack_message(reseal(frame[:4] + b"\x63\x00" + frame[6:]))
+        with pytest.raises(ValueError, match="over 16 bytes"):
+            pack_message(K_CHAIN, 0, "x" * 17, "beta", b"")
+
+    def test_chain_roundtrip_over_socketpair_bit_identical(self):
+        """Satellite wire-honesty lock: a packed prefix chain crosses a
+        REAL OS socketpair and unpacks to byte-identical payloads and
+        device rows — float32 and bfloat16 — and a single flipped bit
+        anywhere in the frame is a loud WireCorruption on the far
+        side.  Locked against the v2 block format fixtures."""
+        import socket as _socket
+
+        from kubeshare_tpu.serving import (KV_WIRE_VERSION,
+                                           WireCorruption, pack_block,
+                                           pack_message, recv_frame,
+                                           send_frame, unpack_block,
+                                           unpack_message)
+        from kubeshare_tpu.serving.fabric import (K_CHAIN,
+                                                  pack_chain_msg,
+                                                  unpack_chain_msg)
+
+        assert KV_WIRE_VERSION == 2
+        rng = np.random.default_rng(7)
+        items = []
+        toks = rng.integers(0, 64, 8).astype(np.int32)
+        for i, dt in enumerate((np.float32, jnp.bfloat16)):
+            k = np.asarray(
+                rng.standard_normal((2, 2, 4, 8)).astype(np.float32))
+            k = np.asarray(jnp.asarray(k, dt)) if dt is jnp.bfloat16 \
+                else k
+            # cumulative root-to-node token path, per-BLOCK payload
+            payload = pack_block(toks[4 * i: 4 * (i + 1)], k, k)
+            items.append((toks[:4 * (i + 1)], payload))
+        frame = pack_message(
+            K_CHAIN, 0, "sender", "receiver",
+            pack_chain_msg("tenant-a", items))
+
+        a, b = _socket.socketpair()
+        try:
+            send_frame(a, frame)
+            got_frame = recv_frame(b)
+            assert got_frame == frame  # the transport is byte-honest
+            _, _, _, _, body = unpack_message(got_frame)
+            tenant, got_items = unpack_chain_msg(body)
+            assert tenant == "tenant-a"
+            assert len(got_items) == len(items)
+            for (toks0, pay0), (toks1, pay1) in zip(items, got_items):
+                assert np.array_equal(toks0, toks1)
+                assert pay0 == pay1  # byte identical through the wire
+                t0, k0, v0 = unpack_block(pay0)
+                t1, k1, v1 = unpack_block(pay1)
+                assert np.array_equal(t0, t1)
+                assert k0.dtype == k1.dtype
+                assert np.array_equal(k0.view(np.uint8),
+                                      k1.view(np.uint8))
+                assert np.array_equal(v0.view(np.uint8),
+                                      v1.view(np.uint8))
+            # a flipped bit in transit is LOUD on the receiving side
+            bad = bytearray(frame)
+            bad[len(bad) // 2] ^= 0x01
+            send_frame(a, bytes(bad))
+            with pytest.raises(WireCorruption):
+                unpack_message(recv_frame(b))
+        finally:
+            a.close()
+            b.close()
+
+    def test_chain_survives_disk_arena_byte_identical(self):
+        """The same honesty through the mmap file: a wire-v2 payload
+        parked in the DISK arena reads back byte identical, and a
+        rotted byte on the platter is a WireCorruption at unpack."""
+        from kubeshare_tpu.serving import (DiskTier, WireCorruption,
+                                           pack_block, unpack_block)
+
+        rng = np.random.default_rng(9)
+        k = rng.standard_normal((2, 2, 4, 8)).astype(np.float32)
+        payload = pack_block(np.arange(4, dtype=np.int32), k, k)
+        tier = DiskTier(budget_bytes=1 << 16)
+        key = tier.put(payload, None, None)
+        assert tier.read(key) == payload
+        t2, k2, v2 = unpack_block(tier.read(key))
+        assert np.array_equal(k2, k) and np.array_equal(v2, k)
+        # rot the platter directly (no chaos clock): loud at unpack
+        entry = tier.probe(key)
+        tier._mm[entry.offset + 11] ^= 0x20
+        with pytest.raises(WireCorruption):
+            unpack_block(tier.read(key))
+        tier.close()
+
+    def test_endpoint_ack_dedup_redelivery_and_ttl(self):
+        """The at-least-once contract end to end: a dropped frame is
+        retransmitted under bounded backoff and delivered exactly once;
+        a dropped ACK triggers a redelivery the receiver absorbs as a
+        duplicate (re-acking it); a partitioned destination expires
+        after ttl_ticks and surfaces through take_expired."""
+        from kubeshare_tpu.serving import (FabricEndpoint,
+                                           LoopbackTransport)
+        from kubeshare_tpu.serving.fabric import K_CHAIN
+
+        class _Flaky(LoopbackTransport):
+            def __init__(self):
+                super().__init__()
+                self.drop_next = 0
+
+            def send(self, dest, frame):
+                if self.drop_next > 0:
+                    self.drop_next -= 1
+                    return
+                super().send(dest, frame)
+
+        tr = _Flaky()
+        a = FabricEndpoint("a", tr, ttl_ticks=8)
+        b = FabricEndpoint("b", tr, ttl_ticks=8)
+        # 1) dropped data frame -> backoff redelivery -> one delivery
+        tr.drop_next = 1
+        mid = a.send("b", K_CHAIN, b"hello")
+        assert b.poll() == [] and a.inflight == 1
+        a.tick()  # due: retransmit
+        got = b.poll()
+        assert [(s, k, m, body) for s, k, m, body in got] == [
+            ("a", K_CHAIN, mid, b"hello")]
+        assert a.poll() == []  # acks are absorbed, not surfaced
+        assert a.take_delivered() == [mid] and a.inflight == 0
+        assert a.redeliveries == 1
+        # 2) dropped ACK -> redelivery -> receiver dedups and re-acks
+        mid2 = a.send("b", K_CHAIN, b"again")
+        tr.drop_next = 1  # the ack is the next frame b sends
+        assert len(b.poll()) == 1
+        assert a.poll() == [] and a.inflight == 1  # ack lost
+        a.tick()
+        assert b.poll() == []  # duplicate absorbed, re-acked
+        assert b.messages[("chain", "duplicate")] == 1
+        a.poll()
+        assert a.take_delivered() == [mid2] and a.inflight == 0
+        # 3) partition: every transmit dropped until TTL
+        tr.drop_next = 10 ** 6
+        mid3 = a.send("b", K_CHAIN, b"doomed")
+        for _ in range(8):
+            a.tick()
+        assert a.inflight == 0
+        assert a.take_expired() == [("b", K_CHAIN, mid3, b"doomed")]
+        assert a.messages[("chain", "expired")] == 1
+        # counters reconcile: delivered + expired == sent
+        assert (a.messages[("chain", "delivered")]
+                + a.messages[("chain", "expired")]
+                == a.messages[("chain", "sent")])
+
+    def test_ticket_body_roundtrip(self):
+        from kubeshare_tpu.serving import pack_ticket, unpack_ticket
+
+        keys = np.asarray([[1, 2], [3, 4]], np.uint32)
+        body = pack_ticket(
+            "rid-1", "tenant-b", np.arange(7, dtype=np.int32), 11, 5,
+            0.8, keys, b"\x00wire\xff", [11, 3], np.asarray([3, 1],
+                                                            np.int32),
+            0.25, last_token_at=123.5)
+        d = unpack_ticket(body)
+        assert d["rid"] == "rid-1" and d["tenant"] == "tenant-b"
+        assert np.array_equal(d["prompt"], np.arange(7))
+        assert (d["first_token"], d["max_new"]) == (11, 5)
+        assert d["temperature"] == 0.8
+        assert np.array_equal(d["step_keys"], keys)
+        assert d["payload"] == b"\x00wire\xff"
+        assert d["emitted_prefix"] == [11, 3]
+        assert list(d["hint"]) == [3, 1]
+        assert d["pack_stall_s"] == 0.25
+        assert d["last_token_at"] == 123.5
+        # greedy: empty key schedule, no hint, no last-token timestamp
+        d2 = unpack_ticket(pack_ticket(
+            "r", "t", np.asarray([1], np.int32), 0, 1, 0.0,
+            np.zeros((0, 0), np.uint32), b"", [], np.asarray([],
+                                                             np.int32),
+            0.0))
+        assert d2["step_keys"].size == 0 and d2["hint"].size == 0
+        assert d2["last_token_at"] is None
+
+    def test_remote_affinity_routes_via_directory(self):
+        """A trie miss everywhere + a directory hit routes to the
+        publishing owner (reason remote_affinity) instead of
+        least-loaded — the fabric's re-prefill saver."""
+        from kubeshare_tpu.serving import (EngineConfig, ReplicaFleet,
+                                           Request)
+        from kubeshare_tpu.serving.fabric import (LoopbackTransport,
+                                                  prefix_fabric_key)
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        fleet = ReplicaFleet(
+            params, config,
+            EngineConfig(num_slots=3, block_size=4, num_blocks=21,
+                         max_request_len=48, prefill_chunk=8),
+            replicas=2, shared_tier_bytes=1 << 20,
+            fabric=LoopbackTransport())
+        rng = np.random.default_rng(3)
+        prompt = rng.integers(0, 64, 14)
+        target = fleet.replicas[1].name
+        # publish the 12-token block boundary as held by replica 1
+        fleet.directory.publish(prefix_fabric_key(prompt[:12]), target,
+                                token_len=12)
+        fleet.submit(Request("q", prompt, 3))
+        fleet.run()
+        assert fleet.owner_of("q") == target
+        assert fleet.routing_decisions["remote_affinity"] == 1
+        # a withdrawn owner falls back to least-loaded (staleness-safe)
+        fleet.directory.withdraw_owner(target)
+        fleet.submit(Request("q2", rng.integers(0, 64, 14), 3))
+        fleet.run()
+        assert fleet.routing_decisions["remote_affinity"] == 1
+
+    def test_fleet_drain_inheritance_rides_the_fabric(self):
+        """The PR-16 drain test, fabric edition: the retiree's trie
+        crosses to the survivor as acked K_CHAIN messages (counted,
+        metered), the directory learns the adopter, and the heir
+        request promotes remotely-adopted host blocks — visible in the
+        remote-vs-local tier-hit split."""
+        from kubeshare_tpu.serving import (EngineConfig, ReplicaFleet,
+                                           Request, flatten_metrics,
+                                           metric_value)
+        from kubeshare_tpu.serving.fabric import LoopbackTransport
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        fleet = ReplicaFleet(
+            params, config,
+            EngineConfig(num_slots=3, block_size=4, num_blocks=21,
+                         max_request_len=48, prefill_chunk=8),
+            replicas=2, shared_tier_bytes=1 << 20,
+            fabric=LoopbackTransport(), fabric_ttl_ticks=8)
+        fleet.warmup()
+        rng = np.random.default_rng(11)
+        shared = rng.integers(0, 64, 16)
+
+        def req(rid):
+            return Request(rid, np.concatenate(
+                [shared, rng.integers(0, 64, 4)]), 4)
+
+        fleet.submit(req("seed"))
+        fleet.run()
+        owner = fleet.owner_of("seed")
+        survivor = [h for h in fleet.replicas if h.name != owner][0]
+        assert survivor.engine.prefix_match_len(shared) == 0
+        fleet.drain(owner)
+        fleet.run()
+        assert fleet._handle(owner).state == "retired"
+        assert survivor.engine.prefix_match_len(shared) >= 16
+        assert fleet.fabric_adopted_tokens > 0
+        assert len(fleet.directory) > 0
+        # the retiree's endpoint is gone; nothing is left in flight
+        assert owner not in fleet._endpoints
+        fleet.submit(req("heir"))
+        fleet.run()
+        assert fleet.owner_of("heir") == survivor.name
+        flat = flatten_metrics(fleet.collect_metrics())
+        delivered = metric_value(
+            flat, "kubeshare_serving_fabric_messages_total",
+            kind="chain", outcome="delivered")
+        sent = metric_value(
+            flat, "kubeshare_serving_fabric_messages_total",
+            kind="chain", outcome="sent")
+        assert delivered > 0 and delivered == sent
+        assert metric_value(
+            flat, "kubeshare_serving_fabric_bytes_total") > 0
+        assert metric_value(
+            flat, "kubeshare_serving_fabric_chain_tokens_adopted_total"
+        ) == fleet.fabric_adopted_tokens
+        # the heir's promotion is charged to the REMOTE origin bucket
+        assert metric_value(
+            flat, "kubeshare_serving_tier_hit_origin_requests_total",
+            origin="remote") >= 1
+
+    def test_disagg_tickets_ride_the_fabric_bit_exact(self):
+        """Handoff tickets as fabric messages: the split-pool router
+        with a loopback fabric emits EXACTLY the monolithic streams —
+        greedy and sampled — and every ticket is acked (delivered ==
+        sent, nothing in flight at drain)."""
+        from kubeshare_tpu.serving import (DisaggRouter, EngineConfig,
+                                           Request, ServingEngine,
+                                           flatten_metrics,
+                                           metric_value)
+        from kubeshare_tpu.serving.fabric import LoopbackTransport
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+
+        def reqs():
+            return [Request(
+                f"r{i}", np.arange(3 + i * 2) % 60, 8,
+                temperature=(0.0 if i % 2 else 0.7),
+                rng=(None if i % 2 else jax.random.PRNGKey(100 + i)))
+                for i in range(5)]
+
+        mono = ServingEngine(params, config, EngineConfig(
+            num_slots=3, block_size=4, num_blocks=41,
+            max_request_len=48, prefill_chunk=8, mixed=False))
+        for r in reqs():
+            mono.submit(r)
+        want = {rid: res.tokens for rid, res in mono.run().items()}
+        router = DisaggRouter(
+            params, config,
+            EngineConfig(num_slots=2, block_size=4, num_blocks=17,
+                         max_request_len=48, prefill_chunk=8,
+                         mixed=False),
+            EngineConfig(num_slots=3, block_size=4, num_blocks=25,
+                         max_request_len=48, prefill_chunk=8,
+                         mixed=False),
+            fabric=LoopbackTransport(), fabric_ttl_ticks=8)
+        for r in reqs():
+            router.submit(r)
+        got = {rid: res.tokens for rid, res in router.run().items()}
+        assert got == want
+        assert router._fabric_inflight == {}
+        assert router._fabric_arrivals == []
+        flat = flatten_metrics(router.collect_metrics())
+        sent = metric_value(flat,
+                            "kubeshare_serving_fabric_messages_total",
+                            kind="ticket", outcome="sent")
+        assert sent == 5
+        assert metric_value(flat,
+                            "kubeshare_serving_fabric_messages_total",
+                            kind="ticket", outcome="delivered") == sent
+
+    def test_prefix_store_export_serve_fetch(self, tmp_path):
+        """The cross-process promotion path's parts: export a
+        disk/host-resident trie to a store file, serve it over TCP,
+        fetch a chain back byte identical, and adopt it into a COLD
+        engine whose next request is a tier hit instead of a
+        re-prefill."""
+        import threading
+
+        from kubeshare_tpu.serving import (EngineConfig, PrefixStoreClient,
+                                           Request, ServingEngine,
+                                           export_prefix_store,
+                                           load_prefix_store,
+                                           serve_prefix_store,
+                                           wire_block_bytes)
+        from kubeshare_tpu.serving.fabric import (prefix_fabric_key,
+                                                  unpack_prefix_blocks)
+        from kubeshare_tpu.serving.kv_tier import adopt_into
+
+        config = _small_config()
+        params = transformer_init(jax.random.PRNGKey(0), config)
+        full_wire = wire_block_bytes(4, config.n_layers, config.kv_heads,
+                                     4, config.head_dim, 4)
+
+        def engine(**over):
+            kw = dict(num_slots=1, block_size=4, num_blocks=13,
+                      max_request_len=32, prefill_chunk=8,
+                      host_tier_bytes=1 << 20)
+            kw.update(over)
+            return ServingEngine(params, config, EngineConfig(**kw))
+
+        rng = np.random.default_rng(11)
+        shared = rng.integers(0, 64, 13)
+        warm = engine()
+        for rid, prompt in (("r0", shared),
+                            ("f1", rng.integers(0, 64, 29)),
+                            ("f2", rng.integers(0, 64, 29))):
+            warm.submit(Request(rid, prompt, 3))
+            warm.run()
+            warm.pop_finished()
+
+        def payload_of(node):
+            if node.host_key is not None:
+                e = warm.host_tier.probe(node.host_key)
+                return None if e is None else e.payload
+            if node.disk_key is not None:
+                return warm.disk_tier.read(node.disk_key)
+            if node.block is not None and node.block >= 0:
+                # live exporter: serialize device rows on the fly (the
+                # bench snapshots after demotion instead)
+                return warm._read_block_payload(node)
+            return None
+
+        path = str(tmp_path / "prefixes.kvps")
+        manifest = export_prefix_store(warm.prefix_index, payload_of,
+                                       path)
+        assert len(manifest) > 0
+        store = load_prefix_store(path)
+        assert set(store) == {k for k, _ in manifest}
+        # serve over real TCP (same-process thread; the bench does the
+        # fork) and fetch the longest chain back
+        import contextlib
+        import io
+        import time
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            t = threading.Thread(target=serve_prefix_store,
+                                 args=(path,), daemon=True)
+            t.start()
+            deadline = time.time() + 10
+            while "PORT" not in buf.getvalue():
+                assert time.time() < deadline, "store never bound"
+                time.sleep(0.01)
+        port = int(buf.getvalue().split()[1])
+        key, token_len = max(manifest, key=lambda kv: kv[1])
+        client = PrefixStoreClient(port)
+        chain = client.fetch(key)
+        assert chain and unpack_prefix_blocks(store[key])[-1][1] \
+            == chain[-1][1]
+        assert client.fetch(b"\x00" * 16) == []  # unknown key: empty
+        client.close()
+        t.join(timeout=10)
+        # adopt the fetched chain into a COLD engine: its next request
+        # over the same prefix is a tier hit, not a re-prefill
+        cold = engine()
+        toks, _ = chain[-1]
+        assert cold.prefix_match_len(toks) == 0
+        for ctoks, payload in chain:
+            adopt_into(cold.host_tier, cold.prefix_index, ctoks,
+                       payload, None, origin="remote")
+        assert cold.prefix_match_len(toks) == len(toks)
+        assert prefix_fabric_key(toks) == key
